@@ -1,0 +1,22 @@
+"""fei_tpu — a TPU-native AI coding-assistant framework.
+
+Capability parity with the reference (david-strejc/fei): tool-calling agent
+loop, code tools, task executor, Memdir + Memorychain memory subsystems, CLI
+and Textual UIs — but the LLM runs in-tree as the ``jax_local`` provider: a
+JAX/XLA autoregressive decoder with Pallas attention/RoPE kernels, a paged
+KV cache, and tensor/expert/sequence parallelism over a ``jax.sharding.Mesh``.
+
+Package layout:
+  fei_tpu.utils     — config / logging / errors / metrics foundation
+  fei_tpu.models    — model definitions (Llama family, Mixtral MoE) as pure
+                      functions over parameter pytrees
+  fei_tpu.ops       — numerics: RMSNorm, RoPE, attention (incl. Pallas kernels)
+  fei_tpu.engine    — tokenizer, KV cache, sampling, decode loop, engine
+  fei_tpu.parallel  — mesh construction, sharding rules, collectives
+  fei_tpu.agent     — Assistant agent loop, providers (jax_local, mock, litellm)
+  fei_tpu.tools     — tool registry/definitions/handlers, code tools, repo map
+  fei_tpu.memory    — Memdir (Maildir store) and Memorychain (distributed ledger)
+  fei_tpu.ui        — CLI REPL and Textual TUI
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,25 @@
+"""Entry point: dispatch to the CLI or the Textual TUI.
+
+Parity with reference fei/__main__.py:11-28 (``--textual`` flag selects the
+TUI; everything else goes to the CLI argparse).
+"""
+
+from __future__ import annotations
+
+import sys
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if "--textual" in argv:
+        argv.remove("--textual")
+        from fei_tpu.ui.textual_chat import main as textual_main
+
+        return textual_main(argv)
+    from fei_tpu.ui.cli import main as cli_main
+
+    return cli_main(argv)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,23 @@
+from fei_tpu.utils.logging import get_logger, setup_logging
+from fei_tpu.utils.config import Config, get_config
+from fei_tpu.utils.errors import (
+    FeiError,
+    ConfigError,
+    ProviderError,
+    ToolError,
+    EngineError,
+    MemoryError_,
+)
+
+__all__ = [
+    "get_logger",
+    "setup_logging",
+    "Config",
+    "get_config",
+    "FeiError",
+    "ConfigError",
+    "ProviderError",
+    "ToolError",
+    "EngineError",
+    "MemoryError_",
+]

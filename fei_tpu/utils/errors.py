@@ -1,0 +1,66 @@
+"""Typed error hierarchy for fei_tpu.
+
+The reference maps transport errors ad hoc (fei/core/assistant.py:543-554 maps
+LiteLLM exceptions to strings); here every subsystem raises a typed subclass of
+FeiError so callers can catch at the right granularity.
+"""
+
+from __future__ import annotations
+
+
+class FeiError(Exception):
+    """Base class for all fei_tpu errors."""
+
+    def __init__(self, message: str, *, cause: Exception | None = None):
+        super().__init__(message)
+        self.message = message
+        self.cause = cause
+
+
+class ConfigError(FeiError):
+    """Invalid or missing configuration."""
+
+
+class ProviderError(FeiError):
+    """LLM provider failure (local engine or remote API)."""
+
+
+class AuthenticationError(ProviderError):
+    """Missing or rejected credentials for a remote provider."""
+
+
+class RateLimitError(ProviderError):
+    """Remote provider rate limit."""
+
+
+class EngineError(FeiError):
+    """TPU inference engine failure (compile, OOM, shape mismatch)."""
+
+
+class CheckpointError(EngineError):
+    """Weight loading / checkpoint save-restore failure."""
+
+
+class ToolError(FeiError):
+    """Tool registration, validation, or execution failure."""
+
+
+class ToolNotFoundError(ToolError):
+    pass
+
+
+class ToolValidationError(ToolError):
+    """Arguments failed JSON-schema validation."""
+
+
+class MemoryError_(FeiError):
+    """Memdir / Memorychain subsystem failure (trailing underscore avoids
+    shadowing the builtin)."""
+
+
+class MCPError(FeiError):
+    """MCP client/service failure."""
+
+
+class TaskExecutionError(FeiError):
+    """Continuous task executor failure."""

@@ -1,0 +1,21 @@
+"""Test harness: force an 8-device virtual CPU mesh so DP/TP/EP/SP tests run
+hermetically without TPU hardware (SURVEY.md §4 implication)."""
+
+import os
+
+# Must be set before jax is imported anywhere in the test process.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def tmp_home(tmp_path, monkeypatch):
+    """Isolated $HOME so config/memdir tests never touch the real one."""
+    monkeypatch.setenv("HOME", str(tmp_path))
+    return tmp_path

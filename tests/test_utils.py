@@ -1,0 +1,132 @@
+"""Foundation tests: config precedence, logging, metrics, errors.
+
+Mirrors the reference's env/config behavior tests (tests/test_env_config*.py,
+test_key_precedence.py, test_llm_api_key_fallback.py)."""
+
+import logging
+import os
+
+import pytest
+
+from fei_tpu.utils.config import Config, ConfigValue
+from fei_tpu.utils.errors import ConfigError, FeiError, ToolError
+from fei_tpu.utils.logging import get_logger, setup_logging
+from fei_tpu.utils.metrics import Metrics
+
+
+def test_schema_defaults(tmp_path):
+    cfg = Config(config_path=str(tmp_path / "none.ini"), env_files=[], environ={})
+    assert cfg.get("llm", "provider") == "jax_local"
+    assert cfg.get("llm", "max_tokens") == 4000
+    assert cfg.get("engine", "dtype") == "bfloat16"
+
+
+def test_file_beats_default(tmp_path):
+    ini = tmp_path / "cfg.ini"
+    ini.write_text("[llm]\nmodel = llama3-70b\nmax_tokens = 123\n")
+    cfg = Config(config_path=str(ini), env_files=[], environ={})
+    assert cfg.get("llm", "model") == "llama3-70b"
+    assert cfg.get("llm", "max_tokens") == 123  # coerced to int
+
+
+def test_env_beats_file(tmp_path):
+    ini = tmp_path / "cfg.ini"
+    ini.write_text("[llm]\nmodel = from-file\n")
+    cfg = Config(
+        config_path=str(ini),
+        env_files=[],
+        environ={"FEI_TPU_LLM_MODEL": "from-env"},
+    )
+    assert cfg.get("llm", "model") == "from-env"
+
+
+def test_dotenv_loaded_but_process_env_wins(tmp_path):
+    envfile = tmp_path / ".env"
+    envfile.write_text("FEI_TPU_LLM_MODEL=from-dotenv\nFEI_TPU_LLM_MAX_TOKENS=7\n")
+    cfg = Config(
+        config_path=str(tmp_path / "none.ini"),
+        env_files=[str(envfile)],
+        environ={"FEI_TPU_LLM_MODEL": "from-process"},
+    )
+    # direct env beats .env (reference test_env_preservation.py:14-31)
+    assert cfg.get("llm", "model") == "from-process"
+    # .env still supplies what process env lacks
+    assert cfg.get("llm", "max_tokens") == 7
+
+
+def test_provider_api_key_fallback(tmp_path):
+    # {PROVIDER}_API_KEY then LLM_API_KEY (reference test_llm_api_key_fallback.py)
+    cfg = Config(
+        config_path=str(tmp_path / "none.ini"),
+        env_files=[],
+        environ={"FEI_TPU_LLM_PROVIDER": "anthropic", "ANTHROPIC_API_KEY": "k1"},
+    )
+    assert cfg.get("llm", "api_key") == "k1"
+    cfg2 = Config(
+        config_path=str(tmp_path / "none.ini"),
+        env_files=[],
+        environ={"FEI_TPU_LLM_PROVIDER": "anthropic", "LLM_API_KEY": "k2"},
+    )
+    assert cfg2.get("llm", "api_key") == "k2"
+
+
+def test_set_persists_and_validates(tmp_path):
+    ini = tmp_path / "cfg.ini"
+    cfg = Config(config_path=str(ini), env_files=[], environ={})
+    cfg.set("llm", "max_tokens", "512")
+    assert Config(config_path=str(ini), env_files=[], environ={}).get(
+        "llm", "max_tokens"
+    ) == 512
+    with pytest.raises(ConfigError):
+        cfg.set("engine", "dtype", "int4")  # not in choices
+    assert cfg.delete("llm", "max_tokens") is True
+    assert cfg.delete("llm", "max_tokens") is False
+
+
+def test_coercion_errors():
+    with pytest.raises(ConfigError):
+        ConfigValue(int).coerce("abc")
+    with pytest.raises(ConfigError):
+        ConfigValue(bool).coerce("maybe")
+    assert ConfigValue(bool).coerce("yes") is True
+    assert ConfigValue(bool).coerce("0") is False
+
+
+def test_secret_masked_in_dict(tmp_path):
+    cfg = Config(
+        config_path=str(tmp_path / "none.ini"),
+        env_files=[],
+        environ={"FEI_TPU_LLM_PROVIDER": "x", "LLM_API_KEY": "sekrit"},
+    )
+    assert cfg.as_dict()["llm"]["api_key"] == "****"
+
+
+def test_logger_hierarchy_and_env_level(monkeypatch):
+    monkeypatch.setenv("FEI_TPU_LOG_LEVEL", "DEBUG")
+    setup_logging()
+    log = get_logger("engine")
+    assert log.name == "fei_tpu.engine"
+    assert logging.getLogger("fei_tpu").level == logging.DEBUG
+    assert get_logger("engine") is log  # cached
+
+
+def test_metrics_counters_and_spans():
+    m = Metrics()
+    m.incr("tok", 5)
+    m.incr("tok", 3)
+    m.gauge("kv_pages", 42)
+    with m.span("decode"):
+        pass
+    snap = m.snapshot()
+    assert snap["counters"]["tok"] == 8
+    assert snap["gauges"]["kv_pages"] == 42
+    assert snap["spans"]["decode"]["count"] == 1
+    m.reset()
+    assert m.snapshot()["counters"] == {}
+
+
+def test_error_hierarchy():
+    assert issubclass(ToolError, FeiError)
+    err = ToolError("bad", cause=ValueError("x"))
+    assert err.message == "bad"
+    assert isinstance(err.cause, ValueError)
