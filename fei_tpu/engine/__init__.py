@@ -1,0 +1,3 @@
+from fei_tpu.engine.engine import InferenceEngine, GenerationConfig
+
+__all__ = ["InferenceEngine", "GenerationConfig"]

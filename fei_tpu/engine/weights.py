@@ -1,0 +1,207 @@
+"""Checkpoint loading: HuggingFace-style safetensors -> stacked param pytree.
+
+Maps per-layer HF Llama/Mixtral tensor names onto the scan-stacked layout of
+models/llama.py (layers concatenated on a leading axis). Loads shard-by-shard
+and layer-by-layer so peak host memory stays near one shard, then devices-put
+with the target sharding (when given) so 70B-class checkpoints stream straight
+into sharded HBM without materializing the full model on one host.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fei_tpu.models.configs import ModelConfig
+from fei_tpu.utils.errors import CheckpointError
+from fei_tpu.utils.logging import get_logger
+
+log = get_logger("engine.weights")
+
+# our stacked name -> HF per-layer template
+_LAYER_MAP = {
+    "attn_norm": "model.layers.{i}.input_layernorm.weight",
+    "wq": "model.layers.{i}.self_attn.q_proj.weight",
+    "wk": "model.layers.{i}.self_attn.k_proj.weight",
+    "wv": "model.layers.{i}.self_attn.v_proj.weight",
+    "wo": "model.layers.{i}.self_attn.o_proj.weight",
+    "mlp_norm": "model.layers.{i}.post_attention_layernorm.weight",
+    "w_gate": "model.layers.{i}.mlp.gate_proj.weight",
+    "w_up": "model.layers.{i}.mlp.up_proj.weight",
+    "w_down": "model.layers.{i}.mlp.down_proj.weight",
+}
+_MOE_LAYER_MAP = {
+    "router": "model.layers.{i}.block_sparse_moe.gate.weight",
+    "w_gate": "model.layers.{i}.block_sparse_moe.experts.{e}.w1.weight",
+    "w_down": "model.layers.{i}.block_sparse_moe.experts.{e}.w2.weight",
+    "w_up": "model.layers.{i}.block_sparse_moe.experts.{e}.w3.weight",
+}
+_TOP_MAP = {
+    "embed": "model.embed_tokens.weight",
+    "final_norm": "model.norm.weight",
+    "lm_head": "lm_head.weight",
+}
+# HF stores linear weights as [out, in]; our pytree uses [in, out] so the
+# forward is x @ w. Norm/embed tensors are kept as-is.
+_TRANSPOSE = {"wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down", "router", "lm_head"}
+
+
+def _open_index(ckpt_dir: str) -> dict[str, str]:
+    """tensor name -> shard filename."""
+    idx_path = os.path.join(ckpt_dir, "model.safetensors.index.json")
+    if os.path.exists(idx_path):
+        with open(idx_path) as f:
+            return json.load(f)["weight_map"]
+    single = os.path.join(ckpt_dir, "model.safetensors")
+    if os.path.exists(single):
+        try:
+            from safetensors import safe_open
+        except ImportError as e:
+            raise CheckpointError("safetensors not available", cause=e)
+        with safe_open(single, framework="np") as f:
+            return {name: "model.safetensors" for name in f.keys()}
+    raise CheckpointError(f"no safetensors checkpoint found in {ckpt_dir}")
+
+
+class _ShardReader:
+    """Keeps at most one shard file open; tensors read lazily."""
+
+    def __init__(self, ckpt_dir: str, weight_map: dict[str, str]):
+        from safetensors import safe_open
+
+        self._safe_open = safe_open
+        self.dir = ckpt_dir
+        self.map = weight_map
+        self._open_name: str | None = None
+        self._open_file = None
+
+    def get(self, name: str) -> np.ndarray:
+        if name not in self.map:
+            raise CheckpointError(f"tensor {name!r} missing from checkpoint")
+        shard = self.map[name]
+        if shard != self._open_name:
+            if self._open_file is not None:
+                del self._open_file
+            self._open_file = self._safe_open(
+                os.path.join(self.dir, shard), framework="np"
+            )
+            self._open_name = shard
+        return self._open_file.get_tensor(name)
+
+
+def load_checkpoint(
+    ckpt_dir: str,
+    cfg: ModelConfig,
+    dtype=jnp.bfloat16,
+    shardings: dict | None = None,
+) -> tuple[ModelConfig, dict]:
+    """Load an HF llama/mixtral safetensors dir into the stacked pytree.
+
+    If a config.json is present, architecture fields override ``cfg`` so the
+    checkpoint is self-describing.
+    """
+    cfg = _merge_hf_config(ckpt_dir, cfg)
+    reader = _ShardReader(ckpt_dir, _open_index(ckpt_dir))
+
+    def put(arr: np.ndarray, path: tuple, transpose: bool) -> jax.Array:
+        if transpose:
+            arr = np.ascontiguousarray(arr.T)
+        out = jnp.asarray(arr, dtype=dtype)
+        if shardings is not None and path in shardings:
+            out = jax.device_put(out, shardings[path])
+        return out
+
+    params: dict = {}
+    for ours, hf in _TOP_MAP.items():
+        if ours == "lm_head" and cfg.tie_embeddings:
+            continue
+        params[ours] = put(reader.get(hf), (ours,), ours in _TRANSPOSE)
+
+    layers: dict = {}
+    layer_map = dict(_LAYER_MAP)
+    if cfg.is_moe:
+        # dense-MLP names don't exist in MoE checkpoints; router stacks like
+        # any per-layer tensor, experts add a nested per-expert loop below
+        for k in ("w_gate", "w_up", "w_down"):
+            del layer_map[k]
+        layer_map["router"] = _MOE_LAYER_MAP["router"]
+    for ours, tmpl in layer_map.items():
+        stack = [
+            put(reader.get(tmpl.format(i=i)), ("layers", ours, i), ours in _TRANSPOSE)
+            for i in range(cfg.num_layers)
+        ]
+        layers[ours] = jnp.stack(stack)
+    if cfg.is_moe:
+        for ours in ("w_gate", "w_up", "w_down"):
+            tmpl = _MOE_LAYER_MAP[ours]
+            layers[ours] = jnp.stack(
+                [
+                    jnp.stack(
+                        [
+                            put(
+                                reader.get(tmpl.format(i=i, e=e)),
+                                ("layers", ours, i, e),
+                                True,
+                            )
+                            for e in range(cfg.num_experts)
+                        ]
+                    )
+                    for i in range(cfg.num_layers)
+                ]
+            )
+    params["layers"] = layers
+    log.info("loaded checkpoint from %s (%d layers)", ckpt_dir, cfg.num_layers)
+    return cfg, params
+
+
+def _merge_hf_config(ckpt_dir: str, cfg: ModelConfig) -> ModelConfig:
+    from dataclasses import replace
+
+    path = os.path.join(ckpt_dir, "config.json")
+    if not os.path.exists(path):
+        return cfg
+    with open(path) as f:
+        hf = json.load(f)
+    fields = dict(
+        vocab_size=hf.get("vocab_size"),
+        hidden_size=hf.get("hidden_size"),
+        intermediate_size=hf.get("intermediate_size"),
+        num_layers=hf.get("num_hidden_layers"),
+        num_heads=hf.get("num_attention_heads"),
+        num_kv_heads=hf.get("num_key_value_heads"),
+        rope_theta=hf.get("rope_theta"),
+        rms_norm_eps=hf.get("rms_norm_eps"),
+        max_seq_len=hf.get("max_position_embeddings"),
+        tie_embeddings=hf.get("tie_word_embeddings"),
+        num_experts=hf.get("num_local_experts"),
+        num_experts_per_tok=hf.get("num_experts_per_tok"),
+        bos_token_id=hf.get("bos_token_id"),
+        eos_token_id=hf.get("eos_token_id"),
+    )
+    fields = {k: v for k, v in fields.items() if v is not None}
+    return replace(cfg, **fields)
+
+
+def save_checkpoint(params: dict, path: str) -> None:
+    """Persist the stacked pytree with orbax (engine-native format)."""
+    try:
+        import orbax.checkpoint as ocp
+
+        ckptr = ocp.PyTreeCheckpointer()
+        ckptr.save(os.path.abspath(path), params, force=True)
+    except Exception as e:
+        raise CheckpointError(f"orbax save to {path} failed: {e}", cause=e)
+
+
+def restore_checkpoint(path: str) -> dict:
+    try:
+        import orbax.checkpoint as ocp
+
+        ckptr = ocp.PyTreeCheckpointer()
+        return ckptr.restore(os.path.abspath(path))
+    except Exception as e:
+        raise CheckpointError(f"orbax restore from {path} failed: {e}", cause=e)

@@ -1,0 +1,3 @@
+from fei_tpu.models.configs import ModelConfig, get_model_config, MODEL_CONFIGS
+
+__all__ = ["ModelConfig", "get_model_config", "MODEL_CONFIGS"]

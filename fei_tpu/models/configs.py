@@ -1,0 +1,117 @@
+"""Model configurations for the fei_tpu engine.
+
+Covers the model families named in BASELINE.json configs: Llama-3 (8B/70B),
+CodeLlama-34B, Mixtral-8x7B MoE — plus tiny presets for hermetic CPU tests.
+All are decoder-only transformers with RMSNorm, RoPE, SwiGLU MLPs, and
+grouped-query attention; Mixtral swaps the dense MLP for a top-2 router over
+8 experts.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    name: str = "tiny"
+    vocab_size: int = 512
+    hidden_size: int = 64
+    intermediate_size: int = 128
+    num_layers: int = 2
+    num_heads: int = 4
+    num_kv_heads: int = 2
+    head_dim: int | None = None  # defaults to hidden_size // num_heads
+    rope_theta: float = 500000.0
+    rms_norm_eps: float = 1e-5
+    max_seq_len: int = 8192
+    tie_embeddings: bool = False
+    # MoE (Mixtral): num_experts == 0 means dense MLP
+    num_experts: int = 0
+    num_experts_per_tok: int = 2
+    # tokenizer/bos/eos defaults (overridden by a real tokenizer when loaded)
+    bos_token_id: int = 1
+    eos_token_id: int = 2
+
+    @property
+    def head_dim_(self) -> int:
+        return self.head_dim if self.head_dim is not None else self.hidden_size // self.num_heads
+
+    @property
+    def is_moe(self) -> bool:
+        return self.num_experts > 0
+
+    def num_params(self) -> int:
+        """Approximate parameter count (for memory planning)."""
+        h, i, v, L = self.hidden_size, self.intermediate_size, self.vocab_size, self.num_layers
+        d = self.head_dim_
+        attn = h * (self.num_heads * d) + 2 * h * (self.num_kv_heads * d) + (self.num_heads * d) * h
+        if self.is_moe:
+            mlp = self.num_experts * 3 * h * i + h * self.num_experts
+        else:
+            mlp = 3 * h * i
+        norms = 2 * h
+        embed = v * h * (1 if self.tie_embeddings else 2)
+        return L * (attn + mlp + norms) + embed + h
+
+
+# Shapes follow the published architecture cards for each family. These are
+# architectural constants (layer/head/dim counts), not code from the reference
+# repo — the reference has no model code at all (SURVEY.md §2: LLM calls go out
+# over HTTP via LiteLLM, fei/core/assistant.py:524-530).
+MODEL_CONFIGS: dict[str, ModelConfig] = {
+    # hermetic-test presets
+    "tiny": ModelConfig(),
+    "debug": ModelConfig(
+        name="debug", vocab_size=512, hidden_size=128, intermediate_size=256,
+        num_layers=4, num_heads=8, num_kv_heads=4, max_seq_len=2048,
+    ),
+    "tiny-moe": ModelConfig(
+        name="tiny-moe", vocab_size=512, hidden_size=64, intermediate_size=128,
+        num_layers=2, num_heads=4, num_kv_heads=2, num_experts=4,
+        num_experts_per_tok=2, max_seq_len=2048,
+    ),
+    # benchmark-scale presets (weights random-init unless a checkpoint is given)
+    "llama3-1b": ModelConfig(
+        name="llama3-1b", vocab_size=128256, hidden_size=2048,
+        intermediate_size=8192, num_layers=16, num_heads=32, num_kv_heads=8,
+        rope_theta=500000.0, max_seq_len=8192, tie_embeddings=True,
+        bos_token_id=128000, eos_token_id=128009,
+    ),
+    "llama3-3b": ModelConfig(
+        name="llama3-3b", vocab_size=128256, hidden_size=3072,
+        intermediate_size=8192, num_layers=28, num_heads=24, num_kv_heads=8,
+        rope_theta=500000.0, max_seq_len=8192, tie_embeddings=True,
+        bos_token_id=128000, eos_token_id=128009,
+    ),
+    "llama3-8b": ModelConfig(
+        name="llama3-8b", vocab_size=128256, hidden_size=4096,
+        intermediate_size=14336, num_layers=32, num_heads=32, num_kv_heads=8,
+        rope_theta=500000.0, max_seq_len=8192,
+        bos_token_id=128000, eos_token_id=128009,
+    ),
+    "llama3-70b": ModelConfig(
+        name="llama3-70b", vocab_size=128256, hidden_size=8192,
+        intermediate_size=28672, num_layers=80, num_heads=64, num_kv_heads=8,
+        rope_theta=500000.0, max_seq_len=8192,
+        bos_token_id=128000, eos_token_id=128009,
+    ),
+    "codellama-34b": ModelConfig(
+        name="codellama-34b", vocab_size=32000, hidden_size=8192,
+        intermediate_size=22016, num_layers=48, num_heads=64, num_kv_heads=8,
+        rope_theta=1000000.0, max_seq_len=16384,
+    ),
+    "mixtral-8x7b": ModelConfig(
+        name="mixtral-8x7b", vocab_size=32000, hidden_size=4096,
+        intermediate_size=14336, num_layers=32, num_heads=32, num_kv_heads=8,
+        rope_theta=1000000.0, max_seq_len=32768,
+        num_experts=8, num_experts_per_tok=2,
+    ),
+}
+
+
+def get_model_config(name: str, **overrides) -> ModelConfig:
+    if name not in MODEL_CONFIGS:
+        raise KeyError(f"unknown model config {name!r}; known: {sorted(MODEL_CONFIGS)}")
+    cfg = MODEL_CONFIGS[name]
+    return replace(cfg, **overrides) if overrides else cfg

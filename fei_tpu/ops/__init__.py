@@ -1,0 +1,5 @@
+from fei_tpu.ops.rmsnorm import rms_norm
+from fei_tpu.ops.rope import compute_rope_freqs, apply_rope
+from fei_tpu.ops.attention import attention
+
+__all__ = ["rms_norm", "compute_rope_freqs", "apply_rope", "attention"]

@@ -1,0 +1,51 @@
+"""Grouped-query attention over a static KV cache.
+
+The XLA-native reference path: one batched einsum per score/output contraction
+so the MXU sees large matmuls, with causal + cache-length masking folded into
+the softmax. The Pallas flash path (fei_tpu.ops.pallas.flash_attention)
+replaces this for long prefills; this version is the correctness oracle and
+the fallback on CPU test meshes.
+
+Shapes (B=batch, T=query len, S=cache len, H=q heads, K=kv heads, D=head dim):
+  q: [B, T, H, D]   k,v: [B, S, K, D]   out: [B, T, H, D]
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    q_positions: jnp.ndarray,  # [B, T] absolute position of each query token
+    kv_length: jnp.ndarray | int,  # [B] or scalar: valid prefix length of cache
+    scale: float | None = None,
+) -> jnp.ndarray:
+    B, T, H, D = q.shape
+    S, K = k.shape[1], k.shape[2]
+    groups = H // K
+    if scale is None:
+        scale = D ** -0.5
+
+    # [B, T, K, G, D] query grouped by kv head
+    qg = q.reshape(B, T, K, groups, D)
+    scores = jnp.einsum("btkgd,bskd->btkgs", qg.astype(jnp.float32), k.astype(jnp.float32))
+    scores = scores * scale
+
+    # mask: key position s is visible to query at absolute position p iff
+    # s <= p and s < kv_length
+    s_pos = jnp.arange(S)[None, None, :]  # [1, 1, S]
+    causal = s_pos <= q_positions[:, :, None]  # [B, T, S]
+    if isinstance(kv_length, int):
+        valid = s_pos < kv_length
+    else:
+        valid = s_pos < kv_length[:, None, None]
+    mask = (causal & valid)[:, :, None, None, :]  # [B, T, 1, 1, S]
+    scores = jnp.where(mask, scores, -1e30)
+
+    probs = jnp.exp(scores - scores.max(axis=-1, keepdims=True))
+    probs = probs / probs.sum(axis=-1, keepdims=True)
+    out = jnp.einsum("btkgs,bskd->btkgd", probs, v.astype(jnp.float32))
+    return out.reshape(B, T, H, D).astype(q.dtype)

@@ -1,0 +1,41 @@
+"""Mixture-of-experts layer (Mixtral-style top-k routing over SwiGLU experts).
+
+Dense-compute formulation: every expert processes every token and the router's
+top-k weights zero out non-selected experts. On TPU this keeps the MXU busy
+with one big batched einsum and avoids data-dependent shapes inside jit; the
+expert-parallel path (fei_tpu.parallel.expert) shards the expert dimension
+over the mesh so each chip only computes its resident experts, turning the
+dense mask into a real compute saving at scale.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def moe_mlp(
+    x: jnp.ndarray,  # [B, T, H]
+    router_w: jnp.ndarray,  # [H, E]
+    w_gate: jnp.ndarray,  # [E, H, I]
+    w_up: jnp.ndarray,  # [E, H, I]
+    w_down: jnp.ndarray,  # [E, I, H]
+    num_experts_per_tok: int,
+) -> jnp.ndarray:
+    B, T, H = x.shape
+    E = router_w.shape[-1]
+    logits = jnp.einsum("bth,he->bte", x.astype(jnp.float32), router_w.astype(jnp.float32))
+    topk_vals, topk_idx = jax.lax.top_k(logits, num_experts_per_tok)  # [B,T,k]
+    topk_weights = jax.nn.softmax(topk_vals, axis=-1)
+    # scatter the normalized top-k weights back to a dense [B,T,E] mask
+    weights = jnp.zeros((B, T, E), dtype=jnp.float32)
+    one_hot = jax.nn.one_hot(topk_idx, E, dtype=jnp.float32)  # [B,T,k,E]
+    weights = jnp.einsum("btk,btke->bte", topk_weights, one_hot)
+
+    # every expert runs on every token; weights gate the combination
+    gate = jnp.einsum("bth,ehi->beti", x, w_gate)
+    up = jnp.einsum("bth,ehi->beti", x, w_up)
+    act = jax.nn.silu(gate.astype(jnp.float32)).astype(x.dtype) * up
+    expert_out = jnp.einsum("beti,eih->beth", act, w_down)  # [B,E,T,H]
+    out = jnp.einsum("bte,beth->bth", weights.astype(x.dtype), expert_out)
+    return out

@@ -1,0 +1,16 @@
+"""RMSNorm. XLA fuses this into neighbouring ops on TPU; the Pallas fused
+variant (ops/pallas/) is only used where fusion boundaries block it."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float = 1e-5) -> jnp.ndarray:
+    """y = x / rms(x) * weight, computed in fp32 for stability, cast back."""
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    y = xf * jax.lax.rsqrt(var + eps)
+    return (y * weight.astype(jnp.float32)).astype(dtype)

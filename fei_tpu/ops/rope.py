@@ -1,0 +1,38 @@
+"""Rotary position embeddings (RoPE).
+
+Split-half convention (as used by Llama/Mixtral): the head dim is split into
+two halves rotated against each other. Frequencies are precomputed once per
+model and indexed by absolute position, so the same function serves prefill
+(positions 0..T-1) and decode (a single absolute position per sequence).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def compute_rope_freqs(head_dim: int, max_seq_len: int, theta: float = 500000.0):
+    """Return (cos, sin) tables of shape [max_seq_len, head_dim // 2], fp32."""
+    inv_freq = 1.0 / (
+        theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
+    )
+    t = jnp.arange(max_seq_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv_freq)  # [T, D/2]
+    return jnp.cos(freqs), jnp.sin(freqs)
+
+
+def apply_rope(
+    x: jnp.ndarray,  # [B, T, H, D]
+    cos: jnp.ndarray,  # [max_seq, D/2]
+    sin: jnp.ndarray,
+    positions: jnp.ndarray,  # [B, T] absolute positions
+) -> jnp.ndarray:
+    """Rotate q or k by position-dependent phases. Shape-preserving."""
+    d2 = x.shape[-1] // 2
+    c = cos[positions][:, :, None, :]  # [B, T, 1, D/2]
+    s = sin[positions][:, :, None, :]
+    x1, x2 = x[..., :d2], x[..., d2:]
+    xf1, xf2 = x1.astype(jnp.float32), x2.astype(jnp.float32)
+    out1 = xf1 * c - xf2 * s
+    out2 = xf2 * c + xf1 * s
+    return jnp.concatenate([out1, out2], axis=-1).astype(x.dtype)

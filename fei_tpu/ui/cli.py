@@ -1,0 +1,17 @@
+"""CLI placeholder — replaced by the full REPL/task CLI later this build.
+
+Exists so the ``fei`` console script and ``python -m fei_tpu`` fail with a
+clear message instead of ModuleNotFoundError while the agent/UI layers land.
+"""
+
+from __future__ import annotations
+
+import sys
+
+
+def main(argv: list[str] | None = None) -> int:
+    sys.stderr.write(
+        "fei_tpu CLI: agent/UI layer not built yet in this checkout; "
+        "the engine is available via fei_tpu.engine.InferenceEngine\n"
+    )
+    return 2

@@ -1,15 +1,27 @@
 """Test harness: force an 8-device virtual CPU mesh so DP/TP/EP/SP tests run
-hermetically without TPU hardware (SURVEY.md §4 implication)."""
+hermetically without TPU hardware (SURVEY.md §4 implication).
+
+The container pins JAX_PLATFORMS=axon (real TPU via tunnel) through a
+sitecustomize hook, so a plain setdefault is not enough — we overwrite the
+env *and* update jax.config before any backend initializes. Set
+FEI_TPU_TEST_PLATFORM=tpu to run the suite against the real chip instead.
+"""
 
 import os
 
-# Must be set before jax is imported anywhere in the test process.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+_platform = os.environ.get("FEI_TPU_TEST_PLATFORM", "cpu")
+if _platform == "cpu":
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+
+import jax  # noqa: E402
+
+if _platform == "cpu":
+    jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,158 @@
+"""Engine tests: streaming decode, determinism, stop tokens, masking,
+tokenizers, and safetensors checkpoint loading."""
+
+import json
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fei_tpu.engine import GenerationConfig, InferenceEngine
+from fei_tpu.engine.tokenizer import ByteTokenizer, EOT_ID, load_tokenizer
+
+
+@pytest.fixture(scope="module")
+def engine():
+    return InferenceEngine.from_config("tiny", dtype=jnp.float32, max_seq_len=128)
+
+
+def test_byte_tokenizer_roundtrip():
+    tok = ByteTokenizer()
+    for text in ("hello", "čeština 中文 🚀", ""):
+        assert tok.decode(tok.encode(text)) == text
+    ids = tok.apply_chat_template(
+        [{"role": "user", "content": "hi"}], add_generation_prompt=True
+    )
+    assert ids[0] == tok.bos_token_id
+    assert EOT_ID in ids
+
+
+def test_load_tokenizer_byte_default():
+    assert isinstance(load_tokenizer(None), ByteTokenizer)
+    assert isinstance(load_tokenizer("byte"), ByteTokenizer)
+
+
+def test_greedy_deterministic(engine):
+    ids = engine.tokenizer.encode("determinism", add_bos=True)
+    a = engine.generate(ids, GenerationConfig(max_new_tokens=8))
+    b = engine.generate(ids, GenerationConfig(max_new_tokens=8))
+    assert a.token_ids == b.token_ids
+    assert len(a.token_ids) <= 8
+
+
+def test_sampling_seed_reproducible(engine):
+    ids = engine.tokenizer.encode("sample", add_bos=True)
+    cfg = GenerationConfig(max_new_tokens=8, temperature=1.0, top_k=50, seed=42)
+    a = engine.generate(ids, cfg)
+    b = engine.generate(ids, cfg)
+    assert a.token_ids == b.token_ids
+
+
+def test_stop_token_halts_stream(engine):
+    ids = engine.tokenizer.encode("stop", add_bos=True)
+    greedy = engine.generate(ids, GenerationConfig(max_new_tokens=8))
+    assert len(greedy.token_ids) >= 2
+    stop_at = greedy.token_ids[1]
+    stopped = engine.generate(
+        ids, GenerationConfig(max_new_tokens=8, stop_token_ids=(stop_at,))
+    )
+    assert stopped.token_ids == greedy.token_ids[:1]
+
+
+def test_logit_mask_constrains_output(engine):
+    ids = engine.tokenizer.encode("mask", add_bos=True)
+    allowed = 105  # byte 'a'
+    mask = jnp.zeros((engine.cfg.vocab_size,), dtype=bool).at[allowed].set(True)
+    res = engine.generate(
+        ids, GenerationConfig(max_new_tokens=4), logit_mask_fn=lambda g: mask
+    )
+    assert res.token_ids == [allowed] * 4
+    assert res.text == "aaaa"
+
+
+def test_prompt_too_long_raises(engine):
+    from fei_tpu.utils.errors import EngineError
+
+    with pytest.raises(EngineError):
+        engine.generate([1] * 500, GenerationConfig(max_new_tokens=1))
+
+
+def test_prefill_bucketing_consistent(engine):
+    """A prompt that is a prefix of a longer one must predict the same first
+    token whether its prefill ran in the small bucket or the big one —
+    i.e. bucket padding must not leak into logits."""
+    prefix = engine.tokenizer.encode("abcdefghij", add_bos=True)  # len 11 -> bucket 16
+    long = prefix + engine.tokenizer.encode("0123456789")  # len 21 -> bucket 32
+    r_small = engine.generate(prefix, GenerationConfig(max_new_tokens=1))
+    engine.generate(long, GenerationConfig(max_new_tokens=1))  # warm bucket 32
+    r_again = engine.generate(prefix, GenerationConfig(max_new_tokens=1))
+    assert r_small.token_ids == r_again.token_ids
+
+
+def test_decode_stops_at_cache_capacity():
+    eng = InferenceEngine.from_config("tiny", dtype=jnp.float32, max_seq_len=32)
+    ids = [1] * 28  # only 4 slots left
+    res = eng.generate(ids, GenerationConfig(max_new_tokens=100))
+    assert len(res.token_ids) <= 4
+
+
+def test_metrics_recorded(engine):
+    from fei_tpu.utils.metrics import METRICS
+
+    ids = engine.tokenizer.encode("metrics", add_bos=True)
+    res = engine.generate(ids, GenerationConfig(max_new_tokens=4))
+    snap = METRICS.snapshot()
+    assert snap["spans"]["prefill"]["count"] >= 1
+    assert res.prompt_tokens == len(ids)
+
+
+def test_hf_safetensors_checkpoint_loads(tmp_path):
+    """Write a tiny HF-style llama checkpoint and verify the loader maps it
+    onto the stacked pytree with transposition."""
+    safetensors = pytest.importorskip("safetensors.numpy")
+    from fei_tpu.models.configs import get_model_config
+
+    cfg = get_model_config("tiny")
+    rng = np.random.default_rng(0)
+    h, d = cfg.hidden_size, cfg.head_dim_
+    H, K, I, L, V = (
+        cfg.num_heads, cfg.num_kv_heads, cfg.intermediate_size,
+        cfg.num_layers, cfg.vocab_size,
+    )
+    tensors = {
+        "model.embed_tokens.weight": rng.standard_normal((V, h)).astype(np.float32),
+        "model.norm.weight": np.ones(h, np.float32),
+        "lm_head.weight": rng.standard_normal((V, h)).astype(np.float32),
+    }
+    for i in range(L):
+        p = f"model.layers.{i}."
+        tensors[p + "input_layernorm.weight"] = np.ones(h, np.float32)
+        tensors[p + "post_attention_layernorm.weight"] = np.ones(h, np.float32)
+        tensors[p + "self_attn.q_proj.weight"] = rng.standard_normal((H * d, h)).astype(np.float32)
+        tensors[p + "self_attn.k_proj.weight"] = rng.standard_normal((K * d, h)).astype(np.float32)
+        tensors[p + "self_attn.v_proj.weight"] = rng.standard_normal((K * d, h)).astype(np.float32)
+        tensors[p + "self_attn.o_proj.weight"] = rng.standard_normal((h, H * d)).astype(np.float32)
+        tensors[p + "mlp.gate_proj.weight"] = rng.standard_normal((I, h)).astype(np.float32)
+        tensors[p + "mlp.up_proj.weight"] = rng.standard_normal((I, h)).astype(np.float32)
+        tensors[p + "mlp.down_proj.weight"] = rng.standard_normal((h, I)).astype(np.float32)
+    safetensors.save_file(tensors, str(tmp_path / "model.safetensors"))
+    (tmp_path / "config.json").write_text(json.dumps({"vocab_size": V}))
+
+    from fei_tpu.engine.weights import load_checkpoint
+
+    loaded_cfg, params = load_checkpoint(str(tmp_path), cfg, dtype=jnp.float32)
+    assert loaded_cfg.vocab_size == V
+    assert params["layers"]["wq"].shape == (L, h, H * d)
+    np.testing.assert_allclose(
+        np.asarray(params["layers"]["wo"][1]),
+        tensors["model.layers.1.self_attn.o_proj.weight"].T,
+        rtol=1e-6,
+    )
+    # loaded params must run
+    from fei_tpu.models.llama import KVCache, forward
+
+    logits, _ = forward(
+        params, loaded_cfg, jnp.array([[1, 2, 3]], jnp.int32),
+        KVCache.create(loaded_cfg, 1, 8, jnp.float32),
+    )
+    assert logits.shape == (1, 3, V)

@@ -1,0 +1,109 @@
+"""Numerics tests for fei_tpu.ops against plain-numpy references."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fei_tpu.ops.attention import attention
+from fei_tpu.ops.moe import moe_mlp
+from fei_tpu.ops.rmsnorm import rms_norm
+from fei_tpu.ops.rope import apply_rope, compute_rope_freqs
+
+
+def test_rmsnorm_matches_reference():
+    x = np.random.default_rng(0).standard_normal((2, 5, 16)).astype(np.float32)
+    w = np.random.default_rng(1).standard_normal(16).astype(np.float32)
+    got = rms_norm(jnp.asarray(x), jnp.asarray(w), eps=1e-5)
+    want = x / np.sqrt((x**2).mean(-1, keepdims=True) + 1e-5) * w
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5, atol=1e-5)
+
+
+def test_rope_identity_at_position_zero():
+    cos, sin = compute_rope_freqs(8, 16, theta=10000.0)
+    x = jnp.asarray(np.random.default_rng(0).standard_normal((1, 1, 2, 8)), jnp.float32)
+    pos = jnp.zeros((1, 1), dtype=jnp.int32)
+    np.testing.assert_allclose(np.asarray(apply_rope(x, cos, sin, pos)), np.asarray(x), atol=1e-6)
+
+
+def test_rope_preserves_norm_and_relative_phase():
+    cos, sin = compute_rope_freqs(8, 64, theta=10000.0)
+    rng = np.random.default_rng(2)
+    q = jnp.asarray(rng.standard_normal((1, 1, 1, 8)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((1, 1, 1, 8)), jnp.float32)
+    for p in (3, 17):
+        rq = apply_rope(q, cos, sin, jnp.full((1, 1), p, jnp.int32))
+        np.testing.assert_allclose(
+            float(jnp.linalg.norm(rq)), float(jnp.linalg.norm(q)), rtol=1e-5
+        )
+    # <rope(q,p), rope(k,p+d)> depends only on d (relative position property)
+    def dot(pq, pk):
+        rq = apply_rope(q, cos, sin, jnp.full((1, 1), pq, jnp.int32))
+        rk = apply_rope(k, cos, sin, jnp.full((1, 1), pk, jnp.int32))
+        return float(jnp.sum(rq * rk))
+
+    assert dot(5, 9) == pytest.approx(dot(20, 24), rel=1e-4)
+
+
+def test_attention_matches_naive_softmax():
+    rng = np.random.default_rng(3)
+    B, T, H, K, D, S = 2, 4, 4, 2, 8, 4
+    q = rng.standard_normal((B, T, H, D)).astype(np.float32)
+    k = rng.standard_normal((B, S, K, D)).astype(np.float32)
+    v = rng.standard_normal((B, S, K, D)).astype(np.float32)
+    pos = np.tile(np.arange(T), (B, 1)).astype(np.int32)
+    got = np.asarray(
+        attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), jnp.asarray(pos), S)
+    )
+    # naive reference with explicit GQA expansion + causal mask
+    k_full = np.repeat(k, H // K, axis=2)  # [B,S,H,D]
+    v_full = np.repeat(v, H // K, axis=2)
+    want = np.zeros_like(got)
+    for b in range(B):
+        for h in range(H):
+            scores = q[b, :, h] @ k_full[b, :, h].T / np.sqrt(D)
+            mask = np.tril(np.ones((T, S), dtype=bool))
+            scores = np.where(mask, scores, -np.inf)
+            p = np.exp(scores - scores.max(-1, keepdims=True))
+            p /= p.sum(-1, keepdims=True)
+            want[b, :, h] = p @ v_full[b, :, h]
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_attention_respects_kv_length():
+    rng = np.random.default_rng(4)
+    B, T, H, K, D, S = 1, 1, 2, 2, 4, 8
+    q = jnp.asarray(rng.standard_normal((B, T, H, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, S, K, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, S, K, D)), jnp.float32)
+    pos = jnp.full((B, T), 100, jnp.int32)  # causal never binds; only kv_length
+    out3 = attention(q, k, v, pos, jnp.array([3]))
+    # zeroing the masked tail must not change the result
+    k2 = k.at[:, 3:].set(999.0)
+    v2 = v.at[:, 3:].set(999.0)
+    out3b = attention(q, k2, v2, pos, jnp.array([3]))
+    np.testing.assert_allclose(np.asarray(out3), np.asarray(out3b), atol=1e-5)
+
+
+def test_moe_topk_gating():
+    rng = np.random.default_rng(5)
+    B, T, H, I, E = 1, 3, 8, 16, 4
+    x = jnp.asarray(rng.standard_normal((B, T, H)), jnp.float32)
+    router = jnp.asarray(rng.standard_normal((H, E)), jnp.float32)
+    wg = jnp.asarray(rng.standard_normal((E, H, I)) * 0.1, jnp.float32)
+    wu = jnp.asarray(rng.standard_normal((E, H, I)) * 0.1, jnp.float32)
+    wd = jnp.asarray(rng.standard_normal((E, I, H)) * 0.1, jnp.float32)
+    out = moe_mlp(x, router, wg, wu, wd, num_experts_per_tok=2)
+    assert out.shape == (B, T, H)
+    # with k == E the result equals a softmax-weighted dense mixture
+    out_full = moe_mlp(x, router, wg, wu, wd, num_experts_per_tok=E)
+    logits = np.asarray(x) @ np.asarray(router)
+    w_all = np.exp(logits - logits.max(-1, keepdims=True))
+    w_all /= w_all.sum(-1, keepdims=True)
+    expert_outs = []
+    for e in range(E):
+        act = np.asarray(x) @ np.asarray(wg)[e]
+        act = act / (1 + np.exp(-act))  # silu
+        expert_outs.append((act * (np.asarray(x) @ np.asarray(wu)[e])) @ np.asarray(wd)[e])
+    want = sum(w_all[..., e, None] * expert_outs[e] for e in range(E))
+    np.testing.assert_allclose(np.asarray(out_full), want, rtol=1e-3, atol=1e-4)
