@@ -1,0 +1,84 @@
+"""Benchmark harness: single-stream decode throughput + TTFT on the local
+TPU chip, per BASELINE.json ("tokens/sec/chip + p50 TTFT for fei --message").
+
+Prints exactly ONE JSON line to stdout:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+vs_baseline is value / 20.0 — the BASELINE.json north-star floor of
+20 tok/s/chip (the reference publishes no numbers of its own; BASELINE.md).
+Progress/debug goes to stderr. Model/dtype/token counts are env-tunable:
+  FEI_TPU_BENCH_MODEL   (default llama3-1b)
+  FEI_TPU_BENCH_TOKENS  (default 256)
+  FEI_TPU_BENCH_PROMPT  (default ~128 tokens)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+
+    from fei_tpu.engine import GenerationConfig, InferenceEngine
+
+    model = os.environ.get("FEI_TPU_BENCH_MODEL", "llama3-1b")
+    n_tokens = int(os.environ.get("FEI_TPU_BENCH_TOKENS", "256"))
+    backend = jax.default_backend()
+    log(f"bench: model={model} backend={backend} devices={jax.devices()}")
+
+    t0 = time.time()
+    engine = InferenceEngine.from_config(
+        model, dtype=jnp.bfloat16, max_seq_len=2048, tokenizer="byte"
+    )
+    log(f"bench: params initialized in {time.time()-t0:.1f}s "
+        f"(~{engine.cfg.num_params()/1e9:.2f}B params)")
+
+    prompt = engine.tokenizer.encode(
+        "Write a Python function that parses a Maildir-style filename into "
+        "its timestamp, unique id, hostname and flag components, returning "
+        "a dict; include error handling for malformed names. " * 2,
+        add_bos=True,
+    )[:128]
+    # ignore_eos: random-weight decode must run the full budget for timing
+    gen = GenerationConfig(max_new_tokens=n_tokens, temperature=0.0, ignore_eos=True)
+
+    # warm-up: compiles prefill bucket + fused decode chunk
+    t0 = time.time()
+    warm = engine.generate_fused(prompt, gen, chunk=64)
+    log(f"bench: warm-up (compile) {time.time()-t0:.1f}s, "
+        f"{len(warm.token_ids)} tokens")
+
+    # timed runs
+    ttfts, tps = [], []
+    for i in range(3):
+        res = engine.generate_fused(prompt, gen, chunk=64)
+        ttfts.append(res.ttft_s)
+        tps.append(res.decode_tokens_per_s)
+        log(f"bench: run {i}: ttft={res.ttft_s*1000:.1f}ms "
+            f"decode={res.decode_tokens_per_s:.1f} tok/s "
+            f"({len(res.token_ids)} tokens)")
+
+    ttft_p50 = sorted(ttfts)[len(ttfts) // 2]
+    tok_s = sorted(tps)[len(tps) // 2]
+    result = {
+        "metric": f"{model}_decode_tok_s_per_chip",
+        "value": round(tok_s, 2),
+        "unit": "tok/s/chip",
+        "vs_baseline": round(tok_s / 20.0, 3),
+    }
+    log(f"bench: p50 ttft={ttft_p50*1000:.1f}ms")
+    print(json.dumps(result), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

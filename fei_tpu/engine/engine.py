@@ -27,6 +27,7 @@ from typing import Callable, Iterator, Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from fei_tpu.engine.sampling import sample_logits
 from fei_tpu.engine.tokenizer import load_tokenizer
@@ -47,6 +48,7 @@ class GenerationConfig:
     top_p: float = 1.0
     seed: int = 0
     stop_token_ids: tuple[int, ...] = ()
+    ignore_eos: bool = False  # benchmark mode: decode the full budget
 
 
 @dataclass
@@ -74,7 +76,6 @@ class InferenceEngine:
         max_seq_len: int | None = None,
         batch_size: int = 1,
         dtype=jnp.bfloat16,
-        shardings=None,
     ):
         self.cfg = model_cfg
         self.params = params
@@ -82,9 +83,10 @@ class InferenceEngine:
         self.max_seq_len = max_seq_len or model_cfg.max_seq_len
         self.batch_size = batch_size
         self.dtype = dtype
-        self.shardings = shardings
+        self.mesh = None  # set by parallel.sharding.shard_engine
         self._prefill_cache: dict[tuple, Callable] = {}
         self._step_cache: dict[tuple, Callable] = {}
+        self._fused_cache: dict[tuple, Callable] = {}
 
     # -- construction -------------------------------------------------------
 
@@ -104,7 +106,6 @@ class InferenceEngine:
     ) -> "InferenceEngine":
         cfg = get_model_config(name, **overrides)
         tok = load_tokenizer(tokenizer)
-        # byte tokenizer needs only 264 ids; shrink tiny test models to match
         if checkpoint_dir:
             from fei_tpu.engine.weights import load_checkpoint
 
@@ -154,6 +155,36 @@ class InferenceEngine:
             self._step_cache[key] = jax.jit(step, donate_argnums=(1,))
         return self._step_cache[key]
 
+    def _fused_fn(self, gen: GenerationConfig, n_steps: int) -> Callable:
+        """One dispatch that decodes ``n_steps`` tokens via lax.scan.
+
+        Token-at-a-time streaming pays a host round-trip per token (~tens of
+        ms over a tunneled chip); this amortizes it to one per chunk, which
+        is what bench-grade throughput and batch generation use."""
+        key = (gen.temperature, gen.top_k, gen.top_p, n_steps)
+        if key not in self._fused_cache:
+            cfg = self.cfg
+            temperature, top_k, top_p = gen.temperature, gen.top_k, gen.top_p
+
+            def fused(params, cache, token, rng):  # token: [B, 1]
+                def body(carry, _):
+                    cache, token, rng = carry
+                    logits, cache = forward(params, cfg, token, cache)
+                    rng, sub = jax.random.split(rng)
+                    nxt = sample_logits(
+                        logits[:, -1, :], sub,
+                        temperature=temperature, top_k=top_k, top_p=top_p,
+                    )
+                    return (cache, nxt[:, None], rng), nxt
+
+                (cache, token, rng), toks = jax.lax.scan(
+                    body, (cache, token, rng), None, length=n_steps
+                )
+                return jnp.swapaxes(toks, 0, 1), cache, token, rng
+
+            self._fused_cache[key] = jax.jit(fused, donate_argnums=(1,))
+        return self._fused_cache[key]
+
     # -- generation ---------------------------------------------------------
 
     def new_cache(self, batch: int | None = None) -> KVCache:
@@ -198,6 +229,8 @@ class InferenceEngine:
         """
         gen = gen or GenerationConfig()
         stops = set(gen.stop_token_ids) | set(self.tokenizer.stop_token_ids)
+        if gen.ignore_eos:
+            stops = set()
         with METRICS.span("prefill", jax_trace=True):
             last_logits, cache = self.prefill([list(prompt_ids)], self.new_cache(1))
             last_logits.block_until_ready()
@@ -252,6 +285,68 @@ class InferenceEngine:
             token_ids=out,
             text=self.tokenizer.decode(out),
             ttft_s=ttft or 0.0,
+            decode_tokens_per_s=tps,
+            prompt_tokens=len(prompt_ids),
+        )
+
+    def generate_fused(
+        self,
+        prompt_ids: Sequence[int],
+        gen: GenerationConfig | None = None,
+        chunk: int = 64,
+    ) -> GenerationResult:
+        """Chunked high-throughput generation: one device dispatch per
+        ``chunk`` decoded tokens. Stop tokens are honored at chunk
+        granularity (host truncates at the first stop)."""
+        gen = gen or GenerationConfig()
+        stops = set(gen.stop_token_ids) | set(self.tokenizer.stop_token_ids)
+        if gen.ignore_eos:
+            stops = set()
+        t0 = time.perf_counter()
+        last_logits, cache = self.prefill([list(prompt_ids)], self.new_cache(1))
+        rng = jax.random.PRNGKey(gen.seed)
+        rng, sub = jax.random.split(rng)
+        tok = sample_logits(
+            last_logits, sub,
+            temperature=gen.temperature, top_k=gen.top_k, top_p=gen.top_p,
+        )
+        first = int(tok[0])
+        ttft = time.perf_counter() - t0
+        budget = min(gen.max_new_tokens, self.max_seq_len - len(prompt_ids))
+        out: list[int] = []
+        if budget > 0 and first not in stops:
+            out.append(first)
+            token = tok.reshape(1, 1)
+            remaining = budget - 1
+            # KV slots available for scan writes (each step writes one)
+            slots_left = self.max_seq_len - len(prompt_ids) - 1
+            while remaining > 0 and slots_left > 0:
+                # always scan a full chunk when the cache has room and
+                # truncate on the host — one compiled program per sampling
+                # config instead of one per tail length
+                n = chunk if slots_left >= chunk else slots_left
+                fused = self._fused_fn(gen, n)
+                toks, cache, token, rng = fused(self.params, cache, token, rng)
+                # ONE host transfer per chunk; indexing the device array per
+                # element would pay a device round-trip per token
+                host = np.asarray(toks)[0, :].tolist()
+                slots_left -= n
+                stopped = False
+                for t in host[: min(n, remaining)]:
+                    if t in stops:
+                        stopped = True
+                        break
+                    out.append(t)
+                if stopped:
+                    break
+                remaining -= n
+        total = time.perf_counter() - t0
+        decode_s = total - ttft
+        tps = (len(out) - 1) / decode_s if len(out) > 1 and decode_s > 0 else 0.0
+        return GenerationResult(
+            token_ids=out,
+            text=self.tokenizer.decode(out),
+            ttft_s=ttft,
             decode_tokens_per_s=tps,
             prompt_tokens=len(prompt_ids),
         )

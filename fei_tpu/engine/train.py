@@ -1,0 +1,65 @@
+"""Training/fine-tuning step for the local models.
+
+Greenfield relative to the reference (which trains nothing — SURVEY.md §5
+lists no model-level checkpoint/optimizer state). The step is a pure function
+jitted over whatever mesh the params live on: with TP/EP-sharded params the
+gradients shard identically and XLA inserts the psum/reduce-scatter
+collectives; the batch axis shards over dp.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from fei_tpu.models.configs import ModelConfig
+from fei_tpu.models.llama import forward_train
+
+
+@dataclass(frozen=True)
+class TrainConfig:
+    learning_rate: float = 1e-4
+    weight_decay: float = 0.0
+    b1: float = 0.9
+    b2: float = 0.95
+    grad_clip: float = 1.0
+    remat: bool = True
+
+
+def make_optimizer(tc: TrainConfig):
+    import optax
+
+    return optax.chain(
+        optax.clip_by_global_norm(tc.grad_clip),
+        optax.adamw(
+            tc.learning_rate, b1=tc.b1, b2=tc.b2, weight_decay=tc.weight_decay
+        ),
+    )
+
+
+def make_train_step(cfg: ModelConfig, tc: TrainConfig | None = None):
+    """Return (optimizer, jitted train_step).
+
+    train_step(params, opt_state, tokens[B,T]) -> (params, opt_state, loss).
+    Loss is next-token cross-entropy over tokens[:, 1:], computed in fp32.
+    """
+    import optax
+
+    tc = tc or TrainConfig()
+    opt = make_optimizer(tc)
+
+    def loss_fn(params, tokens):
+        logits = forward_train(params, cfg, tokens[:, :-1], remat=tc.remat)
+        targets = tokens[:, 1:]
+        loss = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+        return loss.mean()
+
+    def train_step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return opt, jax.jit(train_step, donate_argnums=(0, 1))

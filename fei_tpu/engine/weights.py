@@ -1,10 +1,12 @@
 """Checkpoint loading: HuggingFace-style safetensors -> stacked param pytree.
 
 Maps per-layer HF Llama/Mixtral tensor names onto the scan-stacked layout of
-models/llama.py (layers concatenated on a leading axis). Loads shard-by-shard
-and layer-by-layer so peak host memory stays near one shard, then devices-put
-with the target sharding (when given) so 70B-class checkpoints stream straight
-into sharded HBM without materializing the full model on one host.
+models/llama.py (layers concatenated on a leading axis). Reads shard files
+lazily (at most one open at a time) so host I/O stays near one shard, but the
+stacked pytree is currently materialized on the default device before any
+mesh sharding is applied — fine up to ~host-RAM-sized models. Streaming
+layer-by-layer placement into sharded HBM (needed for 70B on a pod) is a
+planned follow-up; see the `shardings` parameter.
 """
 
 from __future__ import annotations

@@ -88,7 +88,8 @@ def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> dict:
 
 
 def _layer(cfg: ModelConfig, x, lp, cache_k, cache_v, kv_length, positions, cos, sin):
-    """One decoder block. x: [B,T,H]; cache_k/v: [B,S,K,D] (this layer's slice).
+    """One decoder block. x: [B,T,H]; cache_k/v: [B,S,K,D] (this layer's
+    slice) or None for the cache-free training path.
     Returns (x_out, new_cache_k, new_cache_v)."""
     B, T, h = x.shape
     K, d = cfg.num_kv_heads, cfg.head_dim_
@@ -101,12 +102,15 @@ def _layer(cfg: ModelConfig, x, lp, cache_k, cache_v, kv_length, positions, cos,
     q = apply_rope(q, cos, sin, positions)
     k = apply_rope(k, cos, sin, positions)
 
-    # write new k/v at each sequence's current length offset (batch-ragged)
-    def write(buf, new, start):
-        return jax.lax.dynamic_update_slice(buf, new, (start, 0, 0))
+    if cache_k is None:
+        new_k, new_v = k, v
+    else:
+        # write new k/v at each sequence's current length offset (batch-ragged)
+        def write(buf, new, start):
+            return jax.lax.dynamic_update_slice(buf, new, (start, 0, 0))
 
-    new_k = jax.vmap(write)(cache_k, k, kv_length)
-    new_v = jax.vmap(write)(cache_v, v, kv_length)
+        new_k = jax.vmap(write)(cache_k, k, kv_length)
+        new_v = jax.vmap(write)(cache_v, v, kv_length)
 
     attn_out = attention(q, new_k, new_v, positions, kv_length + T)
     x = x + attn_out.reshape(B, T, Hq * d) @ lp["wo"]
@@ -155,3 +159,33 @@ def forward(
     logits = (x @ head.astype(x.dtype)).astype(jnp.float32)
     new_cache = KVCache(k=new_k, v=new_v, length=cache.length + T)
     return logits, new_cache
+
+
+def forward_train(
+    params: dict,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,  # [B, T]
+    remat: bool = True,
+) -> jnp.ndarray:
+    """Cache-free forward for training/fine-tuning: full causal attention
+    over the sequence, layers rematerialized (``jax.checkpoint``) so the
+    backward pass trades FLOPs for HBM. Returns logits [B, T, V] fp32."""
+    B, T = tokens.shape
+    positions = jnp.tile(jnp.arange(T, dtype=jnp.int32)[None, :], (B, 1))
+    cos, sin = compute_rope_freqs(cfg.head_dim_, T, cfg.rope_theta)
+    kv_length = jnp.zeros((B,), dtype=jnp.int32)
+
+    dtype = params["embed"].dtype
+    x = params["embed"][tokens].astype(dtype)
+
+    def body(x, lp):
+        x, _, _ = _layer(cfg, x, lp, None, None, kv_length, positions, cos, sin)
+        return x, None
+
+    if remat:
+        body = jax.checkpoint(body)
+    x, _ = jax.lax.scan(body, x, params["layers"])
+
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    return (x @ head.astype(x.dtype)).astype(jnp.float32)

@@ -28,7 +28,6 @@ def moe_mlp(
     topk_vals, topk_idx = jax.lax.top_k(logits, num_experts_per_tok)  # [B,T,k]
     topk_weights = jax.nn.softmax(topk_vals, axis=-1)
     # scatter the normalized top-k weights back to a dense [B,T,E] mask
-    weights = jnp.zeros((B, T, E), dtype=jnp.float32)
     one_hot = jax.nn.one_hot(topk_idx, E, dtype=jnp.float32)  # [B,T,k,E]
     weights = jnp.einsum("btk,btke->bte", topk_weights, one_hot)
 

@@ -1,0 +1,17 @@
+from fei_tpu.parallel.mesh import make_mesh, parse_mesh_shape, best_mesh_shape
+from fei_tpu.parallel.sharding import (
+    param_shardings,
+    cache_shardings,
+    shard_params,
+    shard_engine,
+)
+
+__all__ = [
+    "make_mesh",
+    "parse_mesh_shape",
+    "best_mesh_shape",
+    "param_shardings",
+    "cache_shardings",
+    "shard_params",
+    "shard_engine",
+]

@@ -1,0 +1,84 @@
+"""Device-mesh construction for DP / TP / EP / SP over ICI.
+
+The reference's only "distribution" is HTTP fan-out between Python processes
+(SURVEY.md §2.4); here the equivalent layer is a ``jax.sharding.Mesh`` whose
+axes XLA lowers to ICI collectives. Axis conventions used across the package:
+
+  dp — data parallel (batch / independent decode requests)
+  tp — tensor parallel (attention heads / MLP hidden / vocab)
+  ep — expert parallel (MoE expert dimension)
+  sp — sequence parallel (ring-attention KV block rotation)
+
+Any axis of size 1 is legal everywhere, so a single chip is just the
+(1,1,1,1) mesh and the same jitted programs serve laptop CPU tests, one v5e
+chip, and a v5e-64 pod.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXES = ("dp", "tp", "ep", "sp")
+
+
+def parse_mesh_shape(spec: str) -> dict[str, int]:
+    """Parse 'dp=2,tp=4' into {'dp': 2, 'tp': 4}."""
+    out: dict[str, int] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, val = part.partition("=")
+        name = name.strip()
+        if name not in AXES:
+            raise ValueError(f"unknown mesh axis {name!r}; valid: {AXES}")
+        out[name] = int(val)
+    return out
+
+
+def best_mesh_shape(
+    n_devices: int, num_kv_heads: int = 8, num_experts: int = 0
+) -> dict[str, int]:
+    """Heuristic factorization of n_devices into (dp, tp, ep).
+
+    TP is capped at num_kv_heads (the KV cache shards over kv heads); MoE
+    models spend a factor on ep up to num_experts; the remainder goes to dp.
+    """
+    remaining = n_devices
+    ep = 1
+    if num_experts > 1:
+        ep = int(np.gcd(remaining, num_experts))
+        remaining //= ep
+    tp = int(np.gcd(remaining, num_kv_heads))
+    remaining //= tp
+    return {"dp": remaining, "tp": tp, "ep": ep}
+
+
+def make_mesh(
+    shape: dict[str, int] | str | None = None,
+    devices=None,
+) -> Mesh:
+    """Build a Mesh with the canonical axis names (missing axes get size 1).
+
+    ``shape`` may be a dict, a 'dp=2,tp=4' string, or None (all devices on
+    the tp axis)."""
+    devices = devices if devices is not None else jax.devices()
+    n = len(devices)
+    if isinstance(shape, str):
+        shape = parse_mesh_shape(shape)
+    if shape is None:
+        shape = {"tp": n}
+    sizes = [int(shape.get(ax, 1)) for ax in AXES]
+    total = int(np.prod(sizes))
+    if total != n:
+        raise ValueError(
+            f"mesh shape {dict(zip(AXES, sizes))} needs {total} devices, have {n}"
+        )
+    dev_array = np.asarray(devices).reshape(sizes)
+    return Mesh(dev_array, AXES)
+
+
+def single_device_mesh() -> Mesh:
+    return make_mesh({"tp": 1}, devices=jax.devices()[:1])

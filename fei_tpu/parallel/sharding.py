@@ -1,0 +1,110 @@
+"""Sharding rules: map the stacked Llama/Mixtral param pytree and KV cache
+onto a mesh; XLA inserts the ICI collectives.
+
+Layout (Megatron-style column/row split so each block needs exactly one
+psum per sublayer, inserted automatically by XLA from the shardings):
+
+  wq/wk/wv  [L, H, heads*D]  -> split output (head) dim over tp   (column)
+  wo        [L, heads*D, H]  -> split input  (head) dim over tp   (row)
+  w_gate/up [L, H, I]        -> split I over tp                   (column)
+  w_down    [L, I, H]        -> split I over tp                   (row)
+  embed     [V, H]           -> split vocab over tp (logits psum-free: each
+                                shard owns a vocab slice; gather at sample)
+  experts   [L, E, ...]      -> E over ep, then I over tp
+  KV cache  [L, B, S, K, D]  -> B over dp, K (kv heads) over tp
+
+Norm weights replicate (tiny). The same rules serve the 8-device CPU test
+mesh and a v5e pod.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def param_specs(is_moe: bool) -> dict:
+    """PartitionSpec pytree matching models/llama.py's param layout."""
+    layers = {
+        "attn_norm": P(),
+        "wq": P(None, None, "tp"),
+        "wk": P(None, None, "tp"),
+        "wv": P(None, None, "tp"),
+        "wo": P(None, "tp", None),
+        "mlp_norm": P(),
+    }
+    if is_moe:
+        layers.update(
+            router=P(),
+            w_gate=P(None, "ep", None, "tp"),
+            w_up=P(None, "ep", None, "tp"),
+            w_down=P(None, "ep", "tp", None),
+        )
+    else:
+        layers.update(
+            w_gate=P(None, None, "tp"),
+            w_up=P(None, None, "tp"),
+            w_down=P(None, "tp", None),
+        )
+    return {
+        "embed": P("tp", None),
+        "layers": layers,
+        "final_norm": P(),
+        "lm_head": P(None, "tp"),
+    }
+
+
+def _tree_shardings(specs: dict, params: dict, mesh: Mesh) -> dict:
+    """Match the spec tree to the actual param tree (lm_head may be absent)."""
+
+    def pick(spec_subtree, param_subtree):
+        if isinstance(param_subtree, dict):
+            return {
+                k: pick(spec_subtree[k], v) for k, v in param_subtree.items()
+            }
+        return NamedSharding(mesh, spec_subtree)
+
+    return pick(specs, params)
+
+
+def param_shardings(params: dict, mesh: Mesh, is_moe: bool) -> dict:
+    return _tree_shardings(param_specs(is_moe), params, mesh)
+
+
+def cache_shardings(mesh: Mesh, batch: int | None = None):
+    """KV-cache shardings. The batch dim shards over dp only when the actual
+    batch divides the dp axis — a batch-1 single-prompt cache on a dp>1 mesh
+    replicates over dp instead of erroring."""
+    from fei_tpu.models.llama import KVCache
+
+    dp = mesh.shape.get("dp", 1)
+    batch_axis = "dp" if (batch is None or batch % dp == 0) else None
+    return KVCache(
+        k=NamedSharding(mesh, P(None, batch_axis, None, "tp", None)),
+        v=NamedSharding(mesh, P(None, batch_axis, None, "tp", None)),
+        length=NamedSharding(mesh, P(batch_axis)),
+    )
+
+
+def shard_params(params: dict, mesh: Mesh, is_moe: bool) -> dict:
+    """device_put the pytree with TP/EP shardings. Axes that don't divide a
+    dimension would error in jax; callers choose mesh sizes accordingly
+    (tp | num_kv_heads etc. via mesh.best_mesh_shape)."""
+    shardings = param_shardings(params, mesh, is_moe)
+    return jax.device_put(params, shardings)
+
+
+def shard_engine(engine, mesh: Mesh) -> None:
+    """Re-home an InferenceEngine onto a mesh in place: params get TP/EP
+    shardings and future caches get DP/TP shardings. The engine's jitted
+    programs pick the shardings up from the committed arrays."""
+    engine.params = shard_params(engine.params, mesh, engine.cfg.is_moe)
+
+    base_new_cache = engine.__class__.new_cache
+
+    def new_cache(batch=None):
+        cache = base_new_cache(engine, batch)
+        return jax.device_put(cache, cache_shardings(mesh, cache.k.shape[1]))
+
+    engine.new_cache = new_cache
+    engine.mesh = mesh
