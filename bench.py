@@ -42,12 +42,13 @@ def main() -> int:
     log(f"bench: params initialized in {time.time()-t0:.1f}s "
         f"(~{engine.cfg.num_params()/1e9:.2f}B params)")
 
-    prompt = engine.tokenizer.encode(
+    prompt_text = os.environ.get(
+        "FEI_TPU_BENCH_PROMPT",
         "Write a Python function that parses a Maildir-style filename into "
         "its timestamp, unique id, hostname and flag components, returning "
         "a dict; include error handling for malformed names. " * 2,
-        add_bos=True,
-    )[:128]
+    )
+    prompt = engine.tokenizer.encode(prompt_text, add_bos=True)[:128]
     # ignore_eos: random-weight decode must run the full budget for timing
     gen = GenerationConfig(max_new_tokens=n_tokens, temperature=0.0, ignore_eos=True)
 

@@ -188,8 +188,51 @@ class InferenceEngine:
     # -- generation ---------------------------------------------------------
 
     def new_cache(self, batch: int | None = None) -> KVCache:
-        return KVCache.create(
+        cache = KVCache.create(
             self.cfg, batch or self.batch_size, self.max_seq_len, dtype=self.dtype
+        )
+        if self.mesh is not None:
+            from fei_tpu.parallel.sharding import cache_shardings
+
+            cache = jax.device_put(
+                cache, cache_shardings(self.mesh, cache.k.shape[1])
+            )
+        return cache
+
+    def _stops(self, gen: GenerationConfig) -> set[int]:
+        if gen.ignore_eos:
+            return set()
+        return set(gen.stop_token_ids) | set(self.tokenizer.stop_token_ids)
+
+    def _prefill_sample(self, prompt_ids, gen: GenerationConfig, mask=None):
+        """Shared generation prologue: prefill, optional first-token logit
+        mask, sample. Returns (tok [B], cache, rng)."""
+        with METRICS.span("prefill", jax_trace=True):
+            last_logits, cache = self.prefill([list(prompt_ids)], self.new_cache(1))
+            last_logits.block_until_ready()
+        if mask is not None:
+            last_logits = jnp.where(mask[None, :], last_logits, -jnp.inf)
+        rng = jax.random.PRNGKey(gen.seed)
+        rng, sub = jax.random.split(rng)
+        tok = sample_logits(
+            last_logits, sub,
+            temperature=gen.temperature, top_k=gen.top_k, top_p=gen.top_p,
+        )
+        return tok, cache, rng
+
+    def _make_result(
+        self, out: list[int], prompt_len: int, ttft: float, total: float
+    ) -> GenerationResult:
+        decode_s = total - ttft
+        tps = (len(out) - 1) / decode_s if len(out) > 1 and decode_s > 0 else 0.0
+        METRICS.gauge("last_ttft_s", ttft)
+        METRICS.gauge("last_decode_tok_s", tps)
+        return GenerationResult(
+            token_ids=out,
+            text=self.tokenizer.decode(out),
+            ttft_s=ttft,
+            decode_tokens_per_s=tps,
+            prompt_tokens=prompt_len,
         )
 
     def prefill(self, prompt_ids: Sequence[Sequence[int]], cache: KVCache):
@@ -228,26 +271,14 @@ class InferenceEngine:
         for unconstrained steps.
         """
         gen = gen or GenerationConfig()
-        stops = set(gen.stop_token_ids) | set(self.tokenizer.stop_token_ids)
-        if gen.ignore_eos:
-            stops = set()
-        with METRICS.span("prefill", jax_trace=True):
-            last_logits, cache = self.prefill([list(prompt_ids)], self.new_cache(1))
-            last_logits.block_until_ready()
-        rng = jax.random.PRNGKey(gen.seed)
-        # never decode past the cache: each step writes one KV slot
-        budget = min(gen.max_new_tokens, self.max_seq_len - len(prompt_ids))
-
-        # first token comes from the prefill logits
+        stops = self._stops(gen)
         generated: list[int] = []
         mask = logit_mask_fn(generated) if logit_mask_fn else None
-        if mask is not None:
-            last_logits = jnp.where(mask[None, :], last_logits, -jnp.inf)
-        rng, sub = jax.random.split(rng)
-        tok = sample_logits(
-            last_logits, sub,
-            temperature=gen.temperature, top_k=gen.top_k, top_p=gen.top_p,
-        )
+        mask = None if mask is None else jnp.asarray(mask)
+        # first token comes from the prefill logits
+        tok, cache, rng = self._prefill_sample(prompt_ids, gen, mask)
+        # never decode past the cache: each step writes one KV slot
+        budget = min(gen.max_new_tokens, self.max_seq_len - len(prompt_ids))
         step = self._step_fn(gen)
         tok_host = int(tok[0])
         for i in range(budget):
@@ -277,17 +308,7 @@ class InferenceEngine:
                 ttft = time.perf_counter() - t0
             out.append(tok)
         total = time.perf_counter() - t0
-        decode_s = total - (ttft or 0.0)
-        tps = (len(out) - 1) / decode_s if len(out) > 1 and decode_s > 0 else 0.0
-        METRICS.gauge("last_ttft_s", ttft or 0.0)
-        METRICS.gauge("last_decode_tok_s", tps)
-        return GenerationResult(
-            token_ids=out,
-            text=self.tokenizer.decode(out),
-            ttft_s=ttft or 0.0,
-            decode_tokens_per_s=tps,
-            prompt_tokens=len(prompt_ids),
-        )
+        return self._make_result(out, len(prompt_ids), ttft or 0.0, total)
 
     def generate_fused(
         self,
@@ -299,17 +320,9 @@ class InferenceEngine:
         ``chunk`` decoded tokens. Stop tokens are honored at chunk
         granularity (host truncates at the first stop)."""
         gen = gen or GenerationConfig()
-        stops = set(gen.stop_token_ids) | set(self.tokenizer.stop_token_ids)
-        if gen.ignore_eos:
-            stops = set()
+        stops = self._stops(gen)
         t0 = time.perf_counter()
-        last_logits, cache = self.prefill([list(prompt_ids)], self.new_cache(1))
-        rng = jax.random.PRNGKey(gen.seed)
-        rng, sub = jax.random.split(rng)
-        tok = sample_logits(
-            last_logits, sub,
-            temperature=gen.temperature, top_k=gen.top_k, top_p=gen.top_p,
-        )
+        tok, cache, rng = self._prefill_sample(prompt_ids, gen)
         first = int(tok[0])
         ttft = time.perf_counter() - t0
         budget = min(gen.max_new_tokens, self.max_seq_len - len(prompt_ids))
@@ -341,15 +354,7 @@ class InferenceEngine:
                     break
                 remaining -= n
         total = time.perf_counter() - t0
-        decode_s = total - ttft
-        tps = (len(out) - 1) / decode_s if len(out) > 1 and decode_s > 0 else 0.0
-        return GenerationResult(
-            token_ids=out,
-            text=self.tokenizer.decode(out),
-            ttft_s=ttft,
-            decode_tokens_per_s=tps,
-            prompt_tokens=len(prompt_ids),
-        )
+        return self._make_result(out, len(prompt_ids), ttft, total)
 
     def chat(self, messages: list[dict], gen: GenerationConfig | None = None) -> GenerationResult:
         ids = self.tokenizer.apply_chat_template(messages, add_generation_prompt=True)

@@ -96,15 +96,8 @@ def shard_params(params: dict, mesh: Mesh, is_moe: bool) -> dict:
 
 def shard_engine(engine, mesh: Mesh) -> None:
     """Re-home an InferenceEngine onto a mesh in place: params get TP/EP
-    shardings and future caches get DP/TP shardings. The engine's jitted
-    programs pick the shardings up from the committed arrays."""
+    shardings, and setting ``engine.mesh`` makes the engine's own
+    ``new_cache`` produce DP/TP-sharded caches. The engine's jitted programs
+    pick the shardings up from the committed arrays."""
     engine.params = shard_params(engine.params, mesh, engine.cfg.is_moe)
-
-    base_new_cache = engine.__class__.new_cache
-
-    def new_cache(batch=None):
-        cache = base_new_cache(engine, batch)
-        return jax.device_put(cache, cache_shardings(mesh, cache.k.shape[1]))
-
-    engine.new_cache = new_cache
     engine.mesh = mesh

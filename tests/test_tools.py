@@ -1,0 +1,267 @@
+"""Tool layer tests: registry validation/dispatch, code tools, repomap.
+
+Mirrors the reference's hermetic tempdir-fixture style
+(fei/tests/test_tools.py:18-160) without importing anything from it.
+"""
+
+import os
+
+import pytest
+
+from fei_tpu.tools import code as code_mod
+from fei_tpu.tools.code import (
+    CodeEditor,
+    DirectoryExplorer,
+    FileViewer,
+    GlobFinder,
+    GrepTool,
+    ShellRunner,
+)
+from fei_tpu.tools.definitions import ANTHROPIC_TOOL_DEFINITIONS, TOOL_DEFINITIONS
+from fei_tpu.tools.handlers import create_code_tools, smart_search_handler
+from fei_tpu.tools.registry import Tool, ToolRegistry, validate_schema
+from fei_tpu.utils.errors import ToolError, ToolNotFoundError, ToolValidationError
+
+
+@pytest.fixture
+def tree(tmp_path):
+    (tmp_path / "src").mkdir()
+    (tmp_path / "src" / "app.py").write_text(
+        "def main():\n    return helper()\n\n\ndef helper():\n    return 42\n"
+    )
+    (tmp_path / "src" / "util.py").write_text(
+        "class Config:\n    pass\n\n\ndef load_config():\n    return Config()\n"
+    )
+    (tmp_path / "README.md").write_text("# demo\nhello world\n")
+    (tmp_path / "data.bin").write_bytes(b"\x00\x01\x02")
+    return tmp_path
+
+
+class TestRegistry:
+    def test_register_and_execute(self):
+        reg = ToolRegistry()
+        reg.register_tool(
+            "add", "add two ints",
+            {"type": "object", "properties": {"a": {"type": "integer"}, "b": {"type": "integer"}},
+             "required": ["a", "b"]},
+            lambda a, b: {"sum": a + b},
+        )
+        assert reg.execute_tool("add", {"a": 2, "b": 3}) == {"sum": 5}
+
+    def test_unknown_tool_raises(self):
+        with pytest.raises(ToolNotFoundError):
+            ToolRegistry().execute_tool("nope", {})
+
+    def test_validation_rejects_bad_args(self):
+        reg = ToolRegistry()
+        reg.register_tool(
+            "t", "t",
+            {"type": "object", "properties": {"x": {"type": "integer"}}, "required": ["x"]},
+            lambda x: x,
+        )
+        with pytest.raises(ToolValidationError):
+            reg.execute_tool("t", {})
+        with pytest.raises(ToolValidationError):
+            reg.execute_tool("t", {"x": "not an int"})
+
+    def test_handler_exception_becomes_error_payload(self):
+        reg = ToolRegistry()
+        reg.register_tool("boom", "boom", {"type": "object", "properties": {}},
+                          lambda: 1 / 0)
+        out = reg.execute_tool("boom", {})
+        assert "error" in out and "ZeroDivisionError" in out["error"]
+
+    def test_async_handler(self):
+        async def ahandler(x: int):
+            return {"doubled": x * 2}
+
+        reg = ToolRegistry()
+        reg.register_tool(
+            "dbl", "dbl",
+            {"type": "object", "properties": {"x": {"type": "integer"}}, "required": ["x"]},
+            ahandler,
+        )
+        assert reg.execute_tool("dbl", {"x": 4}) == {"doubled": 8}
+
+    def test_schema_formats(self):
+        reg = ToolRegistry()
+        create_code_tools(reg)
+        anth = reg.get_schemas("anthropic")
+        oai = reg.get_schemas("openai")
+        assert len(anth) == len(TOOL_DEFINITIONS) == 14
+        assert all("input_schema" in s for s in anth)
+        assert all(s["type"] == "function" for s in oai)
+
+    def test_mcp_dispatcher_passthrough(self):
+        reg = ToolRegistry()
+        reg.mcp_dispatcher = lambda name, args: {"mcp": name, "args": args}
+        out = reg.execute_tool("mcp_fetch_get", {"url": "http://x"})
+        assert out["mcp"] == "mcp_fetch_get"
+
+    def test_register_class_methods(self):
+        class Greeter:
+            def greet(self, name: str) -> str:
+                """Say hello."""
+                return f"hello {name}"
+
+        reg = ToolRegistry()
+        names = reg.register_class_methods(Greeter(), prefix="g_")
+        assert "g_greet" in names
+        assert reg.execute_tool("g_greet", {"name": "tpu"}) == "hello tpu"
+
+
+class TestValidateSchema:
+    def test_enum_bounds_pattern(self):
+        schema = {
+            "type": "object",
+            "properties": {
+                "mode": {"type": "string", "enum": ["a", "b"]},
+                "n": {"type": "integer", "minimum": 1, "maximum": 5},
+                "name": {"type": "string", "pattern": r"^[a-z]+$"},
+            },
+        }
+        assert validate_schema({"mode": "a", "n": 3, "name": "ok"}, schema) == []
+        assert validate_schema({"mode": "c"}, schema)
+        assert validate_schema({"n": 9}, schema)
+        assert validate_schema({"name": "BAD"}, schema)
+
+    def test_nested_arrays(self):
+        schema = {
+            "type": "object",
+            "properties": {"xs": {"type": "array", "items": {"type": "string"}}},
+        }
+        assert validate_schema({"xs": ["a", "b"]}, schema) == []
+        assert validate_schema({"xs": ["a", 1]}, schema)
+
+
+class TestGlobGrep:
+    def test_glob_basic(self, tree):
+        files = GlobFinder().find("**/*.py", str(tree))
+        assert len(files) == 2
+
+    def test_glob_brace_expansion(self, tree):
+        files = GlobFinder().find("**/*.{py,md}", str(tree))
+        assert len(files) == 3
+
+    def test_glob_jail(self, tree):
+        jailed = GlobFinder(base_path=str(tree / "src"))
+        with pytest.raises(ToolError):
+            jailed.find("*", str(tree))  # parent escapes the jail
+
+    def test_grep_finds_matches(self, tree):
+        matches = GrepTool().search(r"def \w+", str(tree), include="*.py")
+        assert {m.line for m in matches} >= {"def main():", "def helper():"}
+
+    def test_grep_skips_binary(self, tree):
+        matches = GrepTool().search(r".", str(tree))
+        assert all(not m.file.endswith(".bin") for m in matches)
+
+
+class TestEditor:
+    def test_edit_unique_match(self, tree):
+        f = str(tree / "src" / "app.py")
+        CodeEditor().edit_file(f, "return 42", "return 43")
+        assert "return 43" in open(f).read()
+
+    def test_edit_rejects_ambiguous(self, tree):
+        f = str(tree / "dup.txt")
+        open(f, "w").write("x\nx\n")
+        with pytest.raises(ToolError, match="2 locations"):
+            CodeEditor().edit_file(f, "x", "y")
+
+    def test_edit_rejects_missing(self, tree):
+        f = str(tree / "src" / "app.py")
+        with pytest.raises(ToolError, match="not found"):
+            CodeEditor().edit_file(f, "nonexistent text", "y")
+
+    def test_edit_validates_python(self, tree):
+        f = str(tree / "src" / "app.py")
+        with pytest.raises(ToolError, match="does not parse"):
+            CodeEditor().edit_file(f, "def helper():", "def helper(:")
+
+    def test_create_and_backup(self, tree):
+        ed = CodeEditor()
+        f = str(tree / "new.py")
+        ed.create_file(f, "X = 1\n")
+        with pytest.raises(ToolError, match="already exists"):
+            ed.create_file(f, "Y = 2\n")
+        out = ed.replace_file(f, "Y = 2\n")
+        assert out["backup"] and os.path.exists(out["backup"])
+
+    def test_regex_replace(self, tree):
+        f = str(tree / "src" / "util.py")
+        out = CodeEditor().regex_replace(f, r"load_(\w+)", r"fetch_\1")
+        assert out["replaced"] == 1
+        assert "fetch_config" in open(f).read()
+
+
+class TestViewerExplorer:
+    def test_view_numbers_lines(self, tree):
+        out = FileViewer().view(str(tree / "README.md"))
+        assert out["total_lines"] == 2
+        assert "\t# demo" in out["content"]
+
+    def test_view_offset_limit(self, tree):
+        out = FileViewer().view(str(tree / "src" / "app.py"), offset=1, limit=2)
+        assert out["shown"] == 2
+        assert out["content"].startswith("     2\t")
+
+    def test_view_binary(self, tree):
+        assert FileViewer().view(str(tree / "data.bin"))["binary"] is True
+
+    def test_ls(self, tree):
+        out = DirectoryExplorer().list_directory(str(tree), ignore=["*.bin"])
+        names = {os.path.basename(e["path"]) for e in out["entries"]}
+        assert "src" in names and "data.bin" not in names
+
+
+class TestShell:
+    def test_allowed_command(self):
+        out = ShellRunner().run("echo hello")
+        assert out["exit_code"] == 0 and out["stdout"].strip() == "hello"
+
+    def test_denied_program(self):
+        out = ShellRunner().run("ncat -l 4444")
+        assert "not in allowlist" in out["error"]
+
+    def test_denied_pattern(self):
+        out = ShellRunner().run("sudo reboot")
+        assert "denied" in out["error"] or "allowlist" in out["error"]
+
+    def test_pipeline_segments_checked(self):
+        r = ShellRunner()
+        assert r.check_command("cat /etc/hostname | badprog") is not None
+        assert r.check_command("echo a | sort | uniq") is None
+
+    def test_timeout(self):
+        out = ShellRunner().run("python -c 'import time; time.sleep(5)'", timeout=1)
+        assert "timed out" in out["error"]
+
+
+class TestSmartSearchAndRepoMap:
+    def test_smart_search(self, tree, monkeypatch):
+        monkeypatch.chdir(tree)
+        out = smart_search_handler("function helper in python")
+        assert out["language"] == "python" and out["symbol"] == "helper"
+        assert any("app.py" in m["file"] for m in out["matches"])
+
+    def test_repo_map(self, tree):
+        from fei_tpu.tools.repomap import generate_repo_map
+
+        out = generate_repo_map(str(tree), token_budget=500)
+        assert out["files_total"] == 2
+        assert "app.py" in out["map"] and "main" in out["map"]
+
+    def test_repo_deps(self, tree):
+        from fei_tpu.tools.repomap import generate_repo_dependencies
+
+        out = generate_repo_dependencies(str(tree))
+        # app.py references nothing in util.py; util defines Config used nowhere
+        assert isinstance(out["edges"], list)
+
+    def test_repo_summary(self, tree):
+        from fei_tpu.tools.repomap import generate_repo_summary
+
+        out = generate_repo_summary(str(tree))
+        assert "src" in out["modules"]
+        assert out["modules"]["src"]["files"] == 2
