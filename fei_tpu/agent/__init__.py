@@ -1,0 +1,29 @@
+"""Agent runtime: providers, conversation state, assistant loop, task executor.
+
+Capability parity with the reference's fei/core package (SURVEY.md §2.1) with
+one deliberate inversion: the LLM transport is an in-tree TPU inference
+backend (``jax_local`` provider over fei_tpu.engine) instead of LiteLLM HTTP
+dispatch (reference fei/core/assistant.py:524-530).
+"""
+
+from fei_tpu.agent.assistant import Assistant
+from fei_tpu.agent.conversation import ConversationManager
+from fei_tpu.agent.providers import (
+    MockProvider,
+    Provider,
+    ProviderManager,
+    ProviderResponse,
+    ToolCall,
+)
+from fei_tpu.agent.task_executor import TaskExecutor
+
+__all__ = [
+    "Assistant",
+    "ConversationManager",
+    "MockProvider",
+    "Provider",
+    "ProviderManager",
+    "ProviderResponse",
+    "TaskExecutor",
+    "ToolCall",
+]

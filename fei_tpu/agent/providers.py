@@ -1,0 +1,377 @@
+"""LLM providers: the in-tree TPU backend and pluggable alternatives.
+
+The provider contract mirrors the reference's transport boundary
+(fei/core/assistant.py:491-530): (messages, system, tools) → (text,
+tool_calls). Three implementations:
+
+- ``JaxLocalProvider`` — the north-star path: an fei_tpu.engine
+  InferenceEngine decoding on the local TPU; zero external API calls.
+  Tool calls are emitted as ``<tool_call>{json}</tool_call>`` blocks and
+  parsed here (optionally enforced on-device by grammar-constrained
+  decoding, fei_tpu.engine.grammar).
+- ``MockProvider`` — scripted responses for hermetic agent-loop tests
+  (the same role the reference's patched litellm_completion plays,
+  fei/tests/test_litellm.py:51-110).
+- ``RemoteProvider`` — optional litellm passthrough for comparison
+  benchmarks (BASELINE.json config #1); requires the litellm package and an
+  API key, both resolved from config/env.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Sequence
+
+from fei_tpu.utils.config import get_config
+from fei_tpu.utils.errors import AuthenticationError, ProviderError
+from fei_tpu.utils.logging import get_logger
+from fei_tpu.utils.metrics import METRICS
+
+log = get_logger("agent.providers")
+
+DEFAULT_MODELS = {
+    "jax_local": "llama3-1b",
+    "anthropic": "claude-3-5-sonnet-20240620",
+    "openai": "gpt-4o",
+    "groq": "llama3-70b-8192",
+}
+
+
+@dataclass
+class ToolCall:
+    id: str
+    name: str
+    arguments: dict
+
+
+@dataclass
+class ProviderResponse:
+    content: str
+    tool_calls: list[ToolCall] = field(default_factory=list)
+    stop_reason: str = "stop"
+    usage: dict = field(default_factory=dict)
+
+
+class Provider:
+    """Abstract transport: complete a conversation, possibly with tools."""
+
+    name = "abstract"
+
+    def complete(
+        self,
+        messages: list[dict],
+        system: str | None = None,
+        tools: list[dict] | None = None,
+        max_tokens: int = 4000,
+    ) -> ProviderResponse:
+        raise NotImplementedError
+
+    def stream(
+        self,
+        messages: list[dict],
+        system: str | None = None,
+        tools: list[dict] | None = None,
+        max_tokens: int = 4000,
+    ):
+        """Yield text deltas, then return the final ProviderResponse via
+        StopIteration.value. Default: no streaming, one chunk."""
+        resp = self.complete(messages, system, tools, max_tokens)
+        if resp.content:
+            yield resp.content
+        return resp
+
+
+_TOOL_CALL_RX = re.compile(r"<tool_call>\s*(\{.*?\})\s*</tool_call>", re.DOTALL)
+_OPEN_TAG = "<tool_call>"
+_CLOSE_TAG = "</tool_call>"
+
+
+def stream_visible(text: str) -> str:
+    """The portion of a partially-decoded response that is safe to show:
+    completed tool-call blocks are removed, an unfinished block or a trailing
+    partial ``<tool_call>`` tag is held back. Monotonic in ``text`` growth,
+    so a streaming UI can emit deltas of it."""
+    out: list[str] = []
+    pos = 0
+    while True:
+        i = text.find(_OPEN_TAG, pos)
+        if i < 0:
+            rest = text[pos:]
+            for k in range(min(len(_OPEN_TAG) - 1, len(rest)), 0, -1):
+                if rest.endswith(_OPEN_TAG[:k]):
+                    rest = rest[:-k]
+                    break
+            out.append(rest)
+            break
+        out.append(text[pos:i])
+        j = text.find(_CLOSE_TAG, i)
+        if j < 0:
+            break  # block still streaming in: hold everything after the tag
+        pos = j + len(_CLOSE_TAG)
+    return "".join(out)
+
+
+def extract_tool_calls(text: str) -> tuple[str, list[ToolCall]]:
+    """Parse ``<tool_call>{...}</tool_call>`` blocks out of model text."""
+    calls: list[ToolCall] = []
+
+    def _strip(m: re.Match) -> str:
+        try:
+            obj = json.loads(m.group(1))
+        except json.JSONDecodeError:
+            log.warning("malformed tool call ignored: %s", m.group(1)[:200])
+            return ""
+        name = obj.get("name")
+        if not name:
+            return ""
+        calls.append(
+            ToolCall(
+                id=f"call_{uuid.uuid4().hex[:12]}",
+                name=str(name),
+                arguments=obj.get("arguments", obj.get("input", {})) or {},
+            )
+        )
+        return ""
+
+    cleaned = _TOOL_CALL_RX.sub(_strip, text).strip()
+    return cleaned, calls
+
+
+def render_tool_prompt(tools: list[dict]) -> str:
+    """System-prompt section teaching the tool-call emission protocol."""
+    lines = [
+        "You can call tools. To call one, emit exactly:",
+        '<tool_call>{"name": "<tool name>", "arguments": {...}}</tool_call>',
+        "Tool results arrive in the next turn. Available tools:",
+    ]
+    for t in tools:
+        schema = t.get("input_schema", t.get("parameters", {}))
+        props = ", ".join(schema.get("properties", {}).keys()) or "none"
+        lines.append(f"- {t['name']}: {t.get('description', '')[:160]} (args: {props})")
+    return "\n".join(lines)
+
+
+class JaxLocalProvider(Provider):
+    """The in-tree TPU decoder as an agent transport."""
+
+    name = "jax_local"
+
+    def __init__(
+        self,
+        model: str | None = None,
+        engine=None,
+        gen_overrides: dict | None = None,
+    ):
+        from fei_tpu.engine import GenerationConfig, InferenceEngine
+
+        self._GenerationConfig = GenerationConfig
+        if engine is not None:
+            self.engine = engine
+        else:
+            cfg = get_config()
+            model = model or cfg.get("jax_local", "model", DEFAULT_MODELS["jax_local"])
+            ckpt = cfg.get("jax_local", "checkpoint_dir", None) or None
+            tokenizer = cfg.get("jax_local", "tokenizer", None)
+            if tokenizer is None:
+                tokenizer = ckpt if ckpt else "byte"
+            max_seq = int(cfg.get("jax_local", "max_seq_len", 8192))
+            import jax.numpy as jnp
+
+            self.engine = InferenceEngine.from_config(
+                model,
+                dtype=jnp.bfloat16,
+                tokenizer=tokenizer,
+                checkpoint_dir=ckpt,
+                max_seq_len=max_seq,
+            )
+        self.gen_overrides = gen_overrides or {}
+
+    def _messages_with_system(
+        self, messages: list[dict], system: str | None, tools: list[dict] | None
+    ) -> list[dict]:
+        sys_parts = [system] if system else []
+        if tools:
+            sys_parts.append(render_tool_prompt(tools))
+        out = []
+        if sys_parts:
+            out.append({"role": "system", "content": "\n\n".join(sys_parts)})
+        for m in messages:
+            role = m.get("role", "user")
+            if role == "tool":
+                out.append(
+                    {"role": "user",
+                     "content": f"<tool_result id={m.get('tool_call_id', '')}>"
+                                f"{m.get('content', '')}</tool_result>"}
+                )
+            else:
+                out.append({"role": role, "content": str(m.get("content", ""))})
+        return out
+
+    def complete(self, messages, system=None, tools=None, max_tokens=4000):
+        chunks = []
+        gen = self.stream(messages, system, tools, max_tokens)
+        while True:
+            try:
+                chunks.append(next(gen))
+            except StopIteration as fin:
+                resp = fin.value
+                return resp
+
+    def stream(self, messages, system=None, tools=None, max_tokens=4000):
+        full = self._messages_with_system(messages, system, tools)
+        ids = self.engine.tokenizer.apply_chat_template(full, add_generation_prompt=True)
+        gen = self._GenerationConfig(
+            max_new_tokens=max_tokens, **self.gen_overrides
+        )
+        out_ids: list[int] = []
+        # Incremental decode: re-decoding the whole sequence per token is
+        # O(n^2); instead decode a bounded pending window and fold it into
+        # ``stable`` at a clean UTF-8 boundary (no trailing U+FFFD).
+        stable = ""
+        pending: list[int] = []
+        text_so_far = ""
+        emitted = 0
+        with METRICS.span("provider.jax_local"):
+            for tok in self.engine.generate_stream(ids, gen):
+                out_ids.append(tok)
+                pending.append(tok)
+                tail = self.engine.tokenizer.decode(pending)
+                text_so_far = stable + tail
+                if len(pending) >= 128 and tail and not tail.endswith("�"):
+                    stable, pending = text_so_far, []
+                visible = stream_visible(text_so_far)
+                if len(visible) > emitted:
+                    yield visible[emitted:]
+                    emitted = len(visible)
+        content, calls = extract_tool_calls(text_so_far)
+        return ProviderResponse(
+            content=content,
+            tool_calls=calls,
+            stop_reason="tool_use" if calls else "stop",
+            usage={"prompt_tokens": len(ids), "completion_tokens": len(out_ids)},
+        )
+
+
+class MockProvider(Provider):
+    """Deterministic scripted provider for hermetic tests and demos."""
+
+    name = "mock"
+
+    def __init__(self, script: Sequence[ProviderResponse | str] | None = None):
+        self.script = list(script or [])
+        self.calls: list[dict] = []
+
+    def complete(self, messages, system=None, tools=None, max_tokens=4000):
+        self.calls.append(
+            {"messages": list(messages), "system": system, "tools": tools}
+        )
+        if self.script:
+            item = self.script.pop(0)
+            if isinstance(item, str):
+                content, calls = extract_tool_calls(item)
+                return ProviderResponse(content, calls,
+                                        "tool_use" if calls else "stop")
+            return item
+        last = messages[-1]["content"] if messages else ""
+        return ProviderResponse(f"[mock] echo: {str(last)[:200]}")
+
+
+class RemoteProvider(Provider):
+    """litellm passthrough for remote-API comparison baselines."""
+
+    name = "remote"
+
+    def __init__(self, provider: str = "anthropic", model: str | None = None,
+                 api_key: str | None = None):
+        try:
+            import litellm  # noqa: F401
+        except ImportError as exc:  # pragma: no cover - env without litellm
+            raise ProviderError(
+                "litellm is not installed; RemoteProvider is unavailable "
+                "(the jax_local provider needs no external packages)"
+            ) from exc
+        self.provider = provider
+        self.model = model or DEFAULT_MODELS.get(provider, provider)
+        self.api_key = api_key or self._resolve_key(provider)
+        if not self.api_key:
+            raise AuthenticationError(
+                f"no API key for provider {provider!r}: set "
+                f"{provider.upper()}_API_KEY or LLM_API_KEY"
+            )
+
+    @staticmethod
+    def _resolve_key(provider: str) -> str | None:
+        cfg = get_config()
+        return (
+            os.environ.get(f"{provider.upper()}_API_KEY")
+            or os.environ.get("LLM_API_KEY")
+            or cfg.get(provider, "api_key", None)
+        )
+
+    def complete(self, messages, system=None, tools=None, max_tokens=4000):
+        import litellm
+
+        msgs = ([{"role": "system", "content": system}] if system else []) + list(messages)
+        kwargs: dict[str, Any] = {
+            "model": f"{self.provider}/{self.model}",
+            "messages": msgs,
+            "max_tokens": max_tokens,
+            "api_key": self.api_key,
+        }
+        if tools:
+            kwargs["tools"] = [
+                {"type": "function",
+                 "function": {"name": t["name"],
+                              "description": t.get("description", ""),
+                              "parameters": t.get("input_schema", {})}}
+                for t in tools
+            ]
+        try:
+            resp = litellm.completion(**kwargs)
+        except Exception as exc:  # noqa: BLE001
+            raise ProviderError(f"remote completion failed: {exc}", cause=exc) from exc
+        choice = resp.choices[0]
+        calls = [
+            ToolCall(tc.id, tc.function.name, json.loads(tc.function.arguments or "{}"))
+            for tc in (choice.message.tool_calls or [])
+        ]
+        return ProviderResponse(
+            content=choice.message.content or "",
+            tool_calls=calls,
+            stop_reason="tool_use" if calls else "stop",
+        )
+
+
+class ProviderManager:
+    """Resolve a provider name (+model/key) into a Provider instance.
+
+    Parity with the reference's ProviderManager (fei/core/assistant.py:25-111)
+    except the default provider is the local TPU backend.
+    """
+
+    def __init__(self, provider: str | None = None, model: str | None = None,
+                 api_key: str | None = None, engine=None):
+        cfg = get_config()
+        self.provider_name = provider or cfg.get("agent", "provider", "jax_local")
+        self.model = model
+        self.api_key = api_key
+        self._engine = engine
+        self._provider: Provider | None = None
+
+    def get_provider(self) -> Provider:
+        if self._provider is None:
+            name = self.provider_name
+            if name == "jax_local":
+                self._provider = JaxLocalProvider(self.model, engine=self._engine)
+            elif name == "mock":
+                self._provider = MockProvider()
+            else:
+                self._provider = RemoteProvider(name, self.model, self.api_key)
+        return self._provider
+
+    def set_provider(self, provider: Provider) -> None:
+        self._provider = provider

@@ -1,17 +1,248 @@
-"""CLI placeholder — replaced by the full REPL/task CLI later this build.
+"""CLI: interactive REPL, one-shot message, continuous task mode, subcommands.
 
-Exists so the ``fei`` console script and ``python -m fei_tpu`` fail with a
-clear message instead of ModuleNotFoundError while the agent/UI layers land.
+Parity with the reference's fei/ui/cli.py:60-786 (REPL with exit/clear/
+history commands, history persistence, --task mode wrapping TaskExecutor,
+history/mcp subcommands), with the provider defaulting to the in-tree
+``jax_local`` TPU backend and tokens streamed to stdout as they decode.
 """
 
 from __future__ import annotations
 
+import argparse
+import asyncio
+import json
+import os
 import sys
+import time
+from collections import deque
+
+from fei_tpu.utils.logging import get_logger, setup_logging
+
+log = get_logger("ui.cli")
+
+HISTORY_DIR = os.path.expanduser("~/.fei_tpu")
+HISTORY_FILE = os.path.join(HISTORY_DIR, "history.json")
+HISTORY_MAX = 100
+
+
+class History:
+    """Rolling JSON history of prompts/responses (parity: cli.py:68-137)."""
+
+    def __init__(self, path: str | None = None, maxlen: int = HISTORY_MAX):
+        # resolved at call time so tests can repoint HISTORY_FILE
+        self.path = path or HISTORY_FILE
+        self.entries: deque = deque(maxlen=maxlen)
+        self._load()
+
+    def _load(self) -> None:
+        try:
+            with open(self.path) as fh:
+                for entry in json.load(fh):
+                    self.entries.append(entry)
+        except (OSError, ValueError):
+            pass
+
+    def save(self) -> None:
+        try:
+            os.makedirs(os.path.dirname(self.path), exist_ok=True)
+            with open(self.path, "w") as fh:
+                json.dump(list(self.entries), fh, indent=1)
+        except OSError as exc:
+            log.warning("could not persist history: %s", exc)
+
+    def add(self, prompt: str, response: str) -> None:
+        self.entries.append(
+            {"ts": time.time(), "prompt": prompt, "response": response[:4000]}
+        )
+        self.save()
+
+
+def build_assistant(args):
+    from fei_tpu.agent import Assistant
+    from fei_tpu.tools import ToolRegistry, create_code_tools
+
+    registry = ToolRegistry()
+    create_code_tools(registry)
+    if getattr(args, "memory_tools", False):
+        try:
+            from fei_tpu.memory.tools import create_memory_tools
+        except ImportError as exc:
+            raise RuntimeError(
+                "memory tools are unavailable in this checkout"
+            ) from exc
+        create_memory_tools(registry)
+    streamed: list[str] = []
+    on_text = None
+    if not getattr(args, "no_stream", False):
+        def on_text(delta: str) -> None:
+            streamed.append(delta)
+            sys.stdout.write(delta)
+            sys.stdout.flush()
+    assistant = Assistant(
+        provider=args.provider,
+        model=args.model,
+        tool_registry=registry,
+        max_tool_rounds=args.max_tool_rounds,
+        max_tokens=args.max_tokens,
+        on_text=on_text,
+    )
+    assistant._streamed = streamed
+    return assistant
+
+
+def emit_final(assistant, response: str) -> None:
+    """Print a turn's final text, accounting for what streaming already
+    showed: in streaming mode, text that never went through on_text (salvaged
+    tool output, post-tool-round content) is still printed."""
+    if assistant.on_text is None:
+        print(response)
+        return
+    streamed = "".join(getattr(assistant, "_streamed", []))
+    print()
+    if response.strip() and response.strip() not in streamed:
+        print(response)
+    getattr(assistant, "_streamed", []).clear()
+
+
+def process_single_message(assistant, message: str, history: History) -> int:
+    response = asyncio.run(assistant.chat(message))
+    emit_final(assistant, response)
+    history.add(message, response)
+    return 0
+
+
+def process_continuous_task(assistant, task: str, max_iterations: int,
+                            history: History) -> int:
+    from fei_tpu.agent import TaskExecutor
+
+    # Task mode prints each iteration's cleaned response instead of streaming
+    # raw text — streaming would show the [TASK_COMPLETE] protocol marker.
+    assistant.on_text = None
+    executor = TaskExecutor(assistant, max_iterations=max_iterations)
+    ctx = asyncio.run(executor.execute_task(task))
+    for i, resp in enumerate(ctx.responses, 1):
+        print(f"--- iteration {i} ---\n{resp}")
+    print(
+        f"\n[task {'completed' if ctx.completed else 'stopped'} after "
+        f"{ctx.iterations} iteration(s), {ctx.duration_s:.1f}s]",
+        file=sys.stderr,
+    )
+    history.add(f"[task] {task}", ctx.final_response)
+    return 0 if ctx.completed else 1
+
+
+def chat_loop(assistant, history: History) -> int:
+    print("fei_tpu interactive chat — 'exit' to quit, 'clear' to reset, "
+          "'history' to list past prompts.")
+    while True:
+        try:
+            line = input("\nyou> ").strip()
+        except (EOFError, KeyboardInterrupt):
+            print()
+            return 0
+        if not line:
+            continue
+        if line in ("exit", "quit"):
+            return 0
+        if line == "clear":
+            assistant.reset()
+            print("[conversation cleared]")
+            continue
+        if line == "history":
+            for i, e in enumerate(history.entries):
+                print(f"{i:3d}. {e['prompt'][:80]}")
+            continue
+        print("fei> ", end="", flush=True)
+        try:
+            response = asyncio.run(assistant.chat(line))
+            emit_final(assistant, response)
+            history.add(line, response)
+        except KeyboardInterrupt:
+            print("\n[interrupted]")
+
+
+def handle_history_command(args) -> int:
+    history = History()
+    if args.history_action == "list":
+        for i, e in enumerate(history.entries):
+            stamp = time.strftime("%Y-%m-%d %H:%M", time.localtime(e["ts"]))
+            print(f"{i:3d}. [{stamp}] {e['prompt'][:100]}")
+    elif args.history_action == "show":
+        idx = args.index
+        if 0 <= idx < len(history.entries):
+            e = list(history.entries)[idx]
+            print(f"prompt: {e['prompt']}\n\nresponse:\n{e['response']}")
+        else:
+            print(f"no history entry {idx}", file=sys.stderr)
+            return 1
+    elif args.history_action == "clear":
+        history.entries.clear()
+        history.save()
+        print("history cleared")
+    return 0
+
+
+def handle_mcp_command(args) -> int:
+    try:
+        from fei_tpu.mcp import MCPManager
+    except ImportError:
+        print("error: MCP support is unavailable in this checkout", file=sys.stderr)
+        return 2
+
+    manager = MCPManager()
+    if args.mcp_action == "list":
+        for name, spec in manager.client.servers.items():
+            kind = "stdio" if spec.get("command") else "http"
+            print(f"{name:20s} {kind:6s} {spec.get('url') or ' '.join(spec.get('command', []))}")
+    elif args.mcp_action == "call":
+        params = json.loads(args.params) if args.params else {}
+        result = asyncio.run(manager.client.call_service(args.service, args.method, params))
+        print(json.dumps(result, indent=2, default=str))
+    return 0
+
+
+def parse_args(argv: list[str] | None = None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(
+        prog="fei", description="fei_tpu — TPU-native coding assistant"
+    )
+    p.add_argument("--message", "-m", help="one-shot message, print reply and exit")
+    p.add_argument("--task", "-t", help="continuous task executed until [TASK_COMPLETE]")
+    p.add_argument("--provider", default=None,
+                   help="jax_local (default, in-tree TPU), mock, or a remote provider name")
+    p.add_argument("--model", default=None, help="model name/config for the provider")
+    p.add_argument("--max-iterations", type=int, default=10, help="task mode iteration cap")
+    p.add_argument("--max-tool-rounds", type=int, default=8)
+    p.add_argument("--max-tokens", type=int, default=4000)
+    p.add_argument("--no-stream", action="store_true", help="print whole replies, not token stream")
+    p.add_argument("--memory-tools", action="store_true", help="register memdir memory tools")
+    p.add_argument("--log-level", default=None)
+    sub = p.add_subparsers(dest="command")
+    hist = sub.add_parser("history", help="inspect saved prompt history")
+    hist.add_argument("history_action", choices=["list", "show", "clear"])
+    hist.add_argument("index", nargs="?", type=int, default=0)
+    mcp = sub.add_parser("mcp", help="MCP service operations")
+    mcp.add_argument("mcp_action", choices=["list", "call"])
+    mcp.add_argument("service", nargs="?")
+    mcp.add_argument("method", nargs="?")
+    mcp.add_argument("--params", help="JSON params for mcp call")
+    return p.parse_args(argv)
 
 
 def main(argv: list[str] | None = None) -> int:
-    sys.stderr.write(
-        "fei_tpu CLI: agent/UI layer not built yet in this checkout; "
-        "the engine is available via fei_tpu.engine.InferenceEngine\n"
-    )
-    return 2
+    args = parse_args(argv)
+    setup_logging(level=args.log_level)
+    if args.command == "history":
+        return handle_history_command(args)
+    if args.command == "mcp":
+        return handle_mcp_command(args)
+    history = History()
+    try:
+        assistant = build_assistant(args)
+    except Exception as exc:  # noqa: BLE001 — startup errors must be readable
+        print(f"error: {exc}", file=sys.stderr)
+        return 2
+    if args.message:
+        return process_single_message(assistant, args.message, history)
+    if args.task:
+        return process_continuous_task(assistant, args.task, args.max_iterations, history)
+    return chat_loop(assistant, history)

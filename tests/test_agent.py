@@ -1,0 +1,242 @@
+"""Agent-loop tests: provider contract, tool rounds, task executor, CLI.
+
+Mirrors the reference's mock-LLM pattern (fei/tests/test_litellm.py:51-110):
+the MockProvider plays the role of the patched litellm_completion.
+"""
+
+import asyncio
+import json
+
+import pytest
+
+from fei_tpu.agent import (
+    Assistant,
+    ConversationManager,
+    MockProvider,
+    ProviderResponse,
+    TaskExecutor,
+    ToolCall,
+)
+from fei_tpu.agent.providers import (
+    extract_tool_calls,
+    render_tool_prompt,
+    stream_visible,
+)
+from fei_tpu.tools import ToolRegistry, create_code_tools
+
+
+def make_assistant(script, registry=None):
+    provider = MockProvider(script)
+    return Assistant(provider=provider, tool_registry=registry), provider
+
+
+class TestExtractToolCalls:
+    def test_extracts_and_strips(self):
+        text = 'Let me look.\n<tool_call>{"name": "GlobTool", "arguments": {"pattern": "*.py"}}</tool_call>'
+        content, calls = extract_tool_calls(text)
+        assert content == "Let me look."
+        assert calls[0].name == "GlobTool"
+        assert calls[0].arguments == {"pattern": "*.py"}
+
+    def test_multiple_calls(self):
+        text = (
+            '<tool_call>{"name": "A", "arguments": {}}</tool_call>'
+            '<tool_call>{"name": "B", "arguments": {"x": 1}}</tool_call>'
+        )
+        _, calls = extract_tool_calls(text)
+        assert [c.name for c in calls] == ["A", "B"]
+
+    def test_malformed_json_ignored(self):
+        content, calls = extract_tool_calls("<tool_call>{not json}</tool_call>ok")
+        assert calls == [] and content == "ok"
+
+    def test_stream_visible_holds_partial_tag(self):
+        assert stream_visible("Sure. <tool_ca") == "Sure. "
+        assert stream_visible("Sure. <tool_cat") == "Sure. <tool_cat"
+
+    def test_stream_visible_strips_block_keeps_tail(self):
+        text = 'before <tool_call>{"name":"A","arguments":{}}</tool_call> after'
+        assert stream_visible(text) == "before  after"
+        # open block held back entirely
+        assert stream_visible('x <tool_call>{"name"') == "x "
+
+    def test_stream_visible_monotonic(self):
+        full = 'hi <tool_call>{"name":"A","arguments":{}}</tool_call> bye'
+        prev = ""
+        for i in range(len(full) + 1):
+            vis = stream_visible(full[:i])
+            assert vis.startswith(prev)
+            prev = vis
+
+    def test_tool_prompt_lists_tools(self):
+        reg = ToolRegistry()
+        create_code_tools(reg)
+        prompt = render_tool_prompt(reg.get_schemas())
+        assert "GlobTool" in prompt and "<tool_call>" in prompt
+
+
+class TestAssistantLoop:
+    def test_plain_chat(self):
+        assistant, provider = make_assistant([ProviderResponse("hello there")])
+        out = asyncio.run(assistant.chat("hi"))
+        assert out == "hello there"
+        roles = [m["role"] for m in assistant.conversation.messages]
+        assert roles == ["user", "assistant"]
+
+    def test_tool_round_trip(self, tmp_path):
+        (tmp_path / "a.py").write_text("x = 1\n")
+        reg = ToolRegistry()
+        create_code_tools(reg)
+        script = [
+            f'<tool_call>{{"name": "GlobTool", "arguments": {{"pattern": "*.py", "path": "{tmp_path}"}}}}</tool_call>',
+            ProviderResponse("I found one python file."),
+        ]
+        assistant, provider = make_assistant(script, reg)
+        out = asyncio.run(assistant.chat("what python files are there?"))
+        assert out == "I found one python file."
+        # second provider call must carry the tool result message
+        second = provider.calls[1]["messages"]
+        tool_msgs = [m for m in second if m["role"] == "tool"]
+        assert len(tool_msgs) == 1
+        assert "a.py" in tool_msgs[0]["content"]
+
+    def test_tool_error_fed_back(self):
+        reg = ToolRegistry()
+        create_code_tools(reg)
+        script = [
+            '<tool_call>{"name": "View", "arguments": {"file_path": "/definitely/missing"}}</tool_call>',
+            ProviderResponse("the file is missing"),
+        ]
+        assistant, provider = make_assistant(script, reg)
+        out = asyncio.run(assistant.chat("read it"))
+        assert out == "the file is missing"
+        tool_msg = [m for m in provider.calls[1]["messages"] if m["role"] == "tool"][0]
+        assert "error" in tool_msg["content"]
+
+    def test_round_limit(self):
+        reg = ToolRegistry()
+        reg.register_tool("Loop", "loops", {"type": "object", "properties": {}},
+                          lambda: {"ok": True})
+        looping = '<tool_call>{"name": "Loop", "arguments": {}}</tool_call>'
+        assistant, provider = make_assistant([looping] * 20, reg)
+        assistant.max_tool_rounds = 3
+        asyncio.run(assistant.chat("go"))
+        assert len(provider.calls) == 4  # initial + 3 rounds
+
+    def test_empty_response_salvaged_from_tool_output(self):
+        reg = ToolRegistry()
+        reg.register_tool("Info", "info", {"type": "object", "properties": {}},
+                          lambda: {"data": 42})
+        script = [
+            '<tool_call>{"name": "Info", "arguments": {}}</tool_call>',
+            ProviderResponse(""),
+        ]
+        assistant, _ = make_assistant(script, reg)
+        out = asyncio.run(assistant.chat("info please"))
+        assert "42" in out
+
+    def test_streaming_callback(self):
+        deltas = []
+        assistant, _ = make_assistant([ProviderResponse("streamed reply")])
+        assistant.on_text = deltas.append
+        out = asyncio.run(assistant.chat("hi"))
+        assert out == "streamed reply"
+        assert "".join(deltas) == "streamed reply"
+
+
+class TestConversationManager:
+    def test_tool_results_stringified(self):
+        conv = ConversationManager()
+        call = ToolCall("id1", "T", {})
+        conv.add_tool_results([(call, {"a": 1})])
+        assert json.loads(conv.messages[0]["content"]) == {"a": 1}
+
+    def test_trim_respects_budget_and_pairs(self):
+        conv = ConversationManager(max_context_tokens=50)
+        conv.add_user_message("word " * 100)
+        conv.add_assistant_message("reply", [ToolCall("i", "T", {})])
+        conv.add_tool_results([(ToolCall("i", "T", {}), "out")])
+        conv.add_user_message("latest question")
+        conv.add_assistant_message("latest answer")
+        roles = [m["role"] for m in conv.messages]
+        assert "tool" not in roles or roles.index("tool") != 0  # never orphaned
+        assert conv.token_estimate() <= 50 or len(conv.messages) == 2
+
+
+class TestTaskExecutor:
+    def test_completes_on_signal(self):
+        script = [
+            ProviderResponse("step one done"),
+            ProviderResponse("all finished [TASK_COMPLETE]"),
+        ]
+        assistant, provider = make_assistant(script)
+        ctx = asyncio.run(TaskExecutor(assistant, max_iterations=5).execute_task("do it"))
+        assert ctx.completed and ctx.iterations == 2
+        assert ctx.final_response == "all finished"
+        # first prompt wraps task in the protocol scaffold
+        assert "[TASK_COMPLETE]" in provider.calls[0]["messages"][0]["content"]
+
+    def test_iteration_cap(self):
+        assistant, _ = make_assistant([ProviderResponse("still going")] * 10)
+        ctx = asyncio.run(TaskExecutor(assistant, max_iterations=3).execute_task("loop"))
+        assert not ctx.completed and ctx.iterations == 3
+
+    def test_interactive_stop(self):
+        assistant, _ = make_assistant([ProviderResponse("going")] * 10)
+        ctx = asyncio.run(
+            TaskExecutor(assistant, max_iterations=10).execute_interactive(
+                "t", confirm=lambda ctx, resp: ctx.iterations < 2
+            )
+        )
+        assert ctx.iterations == 2
+
+
+class TestJaxLocalProvider:
+    def test_end_to_end_tiny_engine(self):
+        import jax.numpy as jnp
+
+        from fei_tpu.agent.providers import JaxLocalProvider
+        from fei_tpu.engine import InferenceEngine
+
+        engine = InferenceEngine.from_config(
+            "tiny", dtype=jnp.float32, max_seq_len=512, tokenizer="byte"
+        )
+        provider = JaxLocalProvider(engine=engine, gen_overrides={"ignore_eos": True})
+        resp = provider.complete(
+            [{"role": "user", "content": "hello"}], system="be brief", max_tokens=8
+        )
+        assert isinstance(resp.content, str)
+        assert resp.usage["completion_tokens"] == 8
+
+    def test_assistant_over_local_engine(self):
+        import jax.numpy as jnp
+
+        from fei_tpu.agent.providers import JaxLocalProvider
+        from fei_tpu.engine import InferenceEngine
+
+        engine = InferenceEngine.from_config(
+            "tiny", dtype=jnp.float32, max_seq_len=512, tokenizer="byte"
+        )
+        provider = JaxLocalProvider(engine=engine, gen_overrides={"ignore_eos": True})
+        assistant = Assistant(provider=provider, max_tokens=8)
+        out = asyncio.run(assistant.chat("2+2?"))
+        assert isinstance(out, str)
+
+
+class TestCLI:
+    def test_one_shot_mock(self, capsys, tmp_path, monkeypatch):
+        import fei_tpu.ui.cli as cli
+
+        monkeypatch.setattr(cli, "HISTORY_FILE", str(tmp_path / "history.json"))
+        rc = cli.main(["--provider", "mock", "--no-stream", "--message", "ping"])
+        assert rc == 0
+        assert "[mock] echo: ping" in capsys.readouterr().out
+
+    def test_history_subcommand(self, capsys, tmp_path, monkeypatch):
+        import fei_tpu.ui.cli as cli
+
+        monkeypatch.setattr(cli, "HISTORY_FILE", str(tmp_path / "history.json"))
+        cli.main(["--provider", "mock", "--no-stream", "--message", "remember me"])
+        rc = cli.main(["history", "list"])
+        out = capsys.readouterr().out
+        assert rc == 0 and "remember me" in out
