@@ -49,8 +49,14 @@ class ToolManager:
             return {"error": str(exc)}
 
     async def execute_tool_async(self, call: ToolCall) -> Any:
-        loop = asyncio.get_running_loop()
-        return await loop.run_in_executor(None, self.execute_tool, call)
+        """Dispatch on the registry's bounded pool (ToolRegistry owns the
+        executor); ToolErrors become error payloads for the model."""
+        if self.registry is None:
+            return {"error": "no tool registry configured"}
+        try:
+            return await self.registry.execute_tool_async(call.name, call.arguments)
+        except ToolError as exc:
+            return {"error": str(exc)}
 
 
 class Assistant:
@@ -92,6 +98,7 @@ class Assistant:
         self.conversation.add_user_message(message)
         system = system_prompt or self.system_prompt
         tools = self.tool_manager.get_tools()
+        outputs_before = len(self.conversation.last_tool_outputs(10**9))
         final_text: list[str] = []
         for round_no in range(self.max_tool_rounds + 1):
             resp = await self._complete(system, tools)
@@ -111,9 +118,11 @@ class Assistant:
             self.conversation.add_tool_results(results)
         text = "\n".join(t for t in final_text if t).strip()
         if not text:
-            # salvage: surface the newest tool output rather than silence
-            outputs = self.conversation.last_tool_outputs(1)
-            text = outputs[-1] if outputs else ""
+            # salvage: surface the newest tool output — but only one produced
+            # during THIS turn, never stale output from an earlier turn
+            outputs = self.conversation.last_tool_outputs(10**9)
+            if len(outputs) > outputs_before:
+                text = outputs[-1]
         return text
 
     def chat_sync(self, message: str, system_prompt: str | None = None) -> str:

@@ -73,15 +73,13 @@ class ConversationManager:
         if self.max_context_tokens is None:
             return
         while len(self.messages) > 2 and self.token_estimate() > self.max_context_tokens:
-            # drop the oldest turn, but never orphan tool results: a tool
-            # message must follow its assistant tool_calls message
+            # drop the oldest turn, but never orphan tool results: when an
+            # assistant message carrying tool_calls goes, ALL consecutive
+            # tool messages that follow it go too
             dropped = self.messages.pop(0)
-            while (
-                dropped.get("tool_calls")
-                and self.messages
-                and self.messages[0]["role"] == "tool"
-            ):
-                dropped = self.messages.pop(0)
+            if dropped.get("tool_calls"):
+                while self.messages and self.messages[0]["role"] == "tool":
+                    self.messages.pop(0)
 
 
 def _stringify(result: Any) -> str:

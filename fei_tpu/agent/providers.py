@@ -230,8 +230,12 @@ class JaxLocalProvider(Provider):
         out_ids: list[int] = []
         # Incremental decode: re-decoding the whole sequence per token is
         # O(n^2); instead decode a bounded pending window and fold it into
-        # ``stable`` at a clean UTF-8 boundary (no trailing U+FFFD).
+        # ``stable`` at a clean UTF-8 boundary (no trailing U+FFFD). A few
+        # tokens of context carry across the fold so tokenizers that strip
+        # a leading space on the first decoded token (sentencepiece) don't
+        # glue words together at chunk boundaries.
         stable = ""
+        ctx: list[int] = []
         pending: list[int] = []
         text_so_far = ""
         emitted = 0
@@ -239,10 +243,11 @@ class JaxLocalProvider(Provider):
             for tok in self.engine.generate_stream(ids, gen):
                 out_ids.append(tok)
                 pending.append(tok)
-                tail = self.engine.tokenizer.decode(pending)
+                ctx_text = self.engine.tokenizer.decode(ctx) if ctx else ""
+                tail = self.engine.tokenizer.decode(ctx + pending)[len(ctx_text):]
                 text_so_far = stable + tail
                 if len(pending) >= 128 and tail and not tail.endswith("�"):
-                    stable, pending = text_so_far, []
+                    stable, ctx, pending = text_so_far, pending[-8:], []
                 visible = stream_visible(text_so_far)
                 if len(visible) > emitted:
                     yield visible[emitted:]
@@ -312,10 +317,40 @@ class RemoteProvider(Provider):
             or cfg.get(provider, "api_key", None)
         )
 
+    @staticmethod
+    def _to_openai_messages(messages: list[dict]) -> list[dict]:
+        """Conversation messages use an internal shape; litellm needs the
+        OpenAI one (tool_calls wrapped in type/function, arguments as a JSON
+        string, tool results keyed by tool_call_id)."""
+        out: list[dict] = []
+        for m in messages:
+            role = m.get("role", "user")
+            if role == "assistant" and m.get("tool_calls"):
+                out.append({
+                    "role": "assistant",
+                    "content": m.get("content") or None,
+                    "tool_calls": [
+                        {"id": c["id"], "type": "function",
+                         "function": {"name": c["name"],
+                                      "arguments": json.dumps(c["arguments"])}}
+                        for c in m["tool_calls"]
+                    ],
+                })
+            elif role == "tool":
+                out.append({
+                    "role": "tool",
+                    "tool_call_id": m.get("tool_call_id", ""),
+                    "content": str(m.get("content", "")),
+                })
+            else:
+                out.append({"role": role, "content": str(m.get("content", ""))})
+        return out
+
     def complete(self, messages, system=None, tools=None, max_tokens=4000):
         import litellm
 
-        msgs = ([{"role": "system", "content": system}] if system else []) + list(messages)
+        msgs = ([{"role": "system", "content": system}] if system else []) \
+            + self._to_openai_messages(messages)
         kwargs: dict[str, Any] = {
             "model": f"{self.provider}/{self.model}",
             "messages": msgs,

@@ -53,42 +53,33 @@ class TaskExecutor:
         self.iteration_delay_s = iteration_delay_s
 
     def _process_response(self, ctx: TaskContext, response: str) -> str:
-        """Record a response; detect and strip the completion signal."""
+        """Record a response; detect and strip the completion signal.
+
+        The signal only counts when it ENDS the response, as the protocol
+        prompt instructs — a model merely restating its instructions
+        mid-text must not terminate the task."""
         if response is None:
             response = ""
         if not response.strip():
             outputs = self.assistant.conversation.last_tool_outputs(1)
             if outputs:
                 response = outputs[-1]
-        if COMPLETION_SIGNAL in response:
+        if response.rstrip().endswith(COMPLETION_SIGNAL):
             ctx.completed = True
-            response = response.replace(COMPLETION_SIGNAL, "").strip()
+            response = response.rstrip()[: -len(COMPLETION_SIGNAL)].strip()
         ctx.responses.append(response)
         return response
 
     async def execute_task(self, task: str, system_prompt: str | None = None) -> TaskContext:
-        ctx = TaskContext(task=task)
-        t0 = time.perf_counter()
-        prompt = TASK_PROMPT_TEMPLATE.format(signal=COMPLETION_SIGNAL, task=task)
-        while ctx.iterations < self.max_iterations:
-            ctx.iterations += 1
-            response = await self.assistant.chat(prompt, system_prompt)
-            self._process_response(ctx, response)
-            if ctx.completed:
-                break
-            prompt = CONTINUE_PROMPT
-            if self.iteration_delay_s:
-                await asyncio.sleep(self.iteration_delay_s)
-        ctx.duration_s = time.perf_counter() - t0
-        if not ctx.completed:
-            log.warning("task hit iteration cap (%d) without %s",
-                        self.max_iterations, COMPLETION_SIGNAL)
-        return ctx
+        return await self.execute_interactive(
+            task, confirm=lambda ctx, resp: True, system_prompt=system_prompt
+        )
 
     async def execute_interactive(self, task: str, confirm, system_prompt=None) -> TaskContext:
-        """Like execute_task but calls ``confirm(ctx, response) -> bool``
+        """Run the iteration loop, calling ``confirm(ctx, response) -> bool``
         between iterations; False stops the loop (parity:
-        fei/core/task_executor.py:262)."""
+        fei/core/task_executor.py:262). execute_task is the
+        confirm-always-True case."""
         ctx = TaskContext(task=task)
         t0 = time.perf_counter()
         prompt = TASK_PROMPT_TEMPLATE.format(signal=COMPLETION_SIGNAL, task=task)
@@ -101,5 +92,10 @@ class TaskExecutor:
             if not confirm(ctx, shown):
                 break
             prompt = CONTINUE_PROMPT
+            if self.iteration_delay_s:
+                await asyncio.sleep(self.iteration_delay_s)
         ctx.duration_s = time.perf_counter() - t0
+        if not ctx.completed:
+            log.warning("task stopped after %d iteration(s) without %s",
+                        ctx.iterations, COMPLETION_SIGNAL)
         return ctx

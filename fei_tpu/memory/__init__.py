@@ -1,0 +1,3 @@
+"""Memory subsystems: Memdir (Maildir-style file store + HTTP API) and
+Memorychain (distributed memory/task ledger). Capability parity with the
+reference's memdir_tools package (SURVEY.md §2.2)."""

@@ -1,0 +1,3 @@
+from fei_tpu.memory.memdir.cli import main
+
+raise SystemExit(main())

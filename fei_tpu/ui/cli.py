@@ -99,7 +99,10 @@ def emit_final(assistant, response: str) -> None:
         return
     streamed = "".join(getattr(assistant, "_streamed", []))
     print()
-    if response.strip() and response.strip() not in streamed:
+    # whitespace-normalized containment: multi-round responses join with
+    # newlines the stream never carried
+    norm = lambda s: " ".join(s.split())  # noqa: E731
+    if response.strip() and norm(response) not in norm(streamed):
         print(response)
     getattr(assistant, "_streamed", []).clear()
 
