@@ -98,7 +98,13 @@ class Assistant:
         self.conversation.add_user_message(message)
         system = system_prompt or self.system_prompt
         tools = self.tool_manager.get_tools()
-        outputs_before = len(self.conversation.last_tool_outputs(10**9))
+        # identify pre-existing tool results by call id (counts break when
+        # _trim prunes old tool messages mid-turn)
+        seen_call_ids = {
+            m.get("tool_call_id")
+            for m in self.conversation.messages
+            if m["role"] == "tool"
+        }
         final_text: list[str] = []
         for round_no in range(self.max_tool_rounds + 1):
             resp = await self._complete(system, tools)
@@ -120,9 +126,13 @@ class Assistant:
         if not text:
             # salvage: surface the newest tool output — but only one produced
             # during THIS turn, never stale output from an earlier turn
-            outputs = self.conversation.last_tool_outputs(10**9)
-            if len(outputs) > outputs_before:
-                text = outputs[-1]
+            fresh = [
+                m["content"]
+                for m in self.conversation.messages
+                if m["role"] == "tool" and m.get("tool_call_id") not in seen_call_ids
+            ]
+            if fresh:
+                text = fresh[-1]
         return text
 
     def chat_sync(self, message: str, system_prompt: str | None = None) -> str:

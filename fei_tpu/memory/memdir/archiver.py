@@ -96,12 +96,24 @@ class MemoryArchiver:
         return stats
 
     def empty_trash(self, now: float | None = None) -> int:
-        """Hard-delete trash older than trash_days."""
+        """Hard-delete trash that has BEEN IN TRASH for trash_days. The move
+        into .Trash is a rename, which bumps the inode ctime — expiring on
+        ctime (not the creation timestamp in the filename) gives old
+        memories the same grace period as fresh ones."""
+        import os as _os
+
         now = now or time.time()
         removed = 0
         for status in ("new", "cur"):
             for mem in self.store.list(".Trash", status):
-                if now - mem.timestamp > self.trash_days * 86400:
+                fp = _os.path.join(
+                    self.store.folder_path(".Trash"), status, mem.filename
+                )
+                try:
+                    trashed_at = _os.stat(fp).st_ctime
+                except OSError:
+                    continue
+                if now - trashed_at > self.trash_days * 86400:
                     if self.store.delete(mem.id, ".Trash", hard=True):
                         removed += 1
         return removed

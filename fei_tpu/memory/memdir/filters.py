@@ -93,6 +93,7 @@ class FilterManager:
         for mem in self.store.list(folder, "new", with_content=True):
             stats["processed"] += 1
             acted = False
+            moved_away = False
             for filt in self.filters:
                 if filt.matches(mem):
                     try:
@@ -105,15 +106,18 @@ class FilterManager:
                         {"filter": filt.name, "memory": mem.id, "applied": actions}
                     )
                     acted = True
-                    refreshed = self.store.get(mem.id)
-                    if refreshed is None or refreshed.folder != folder:
+                    if filt.actions.get("move"):
+                        moved_away = True
                         break  # moved away: later filters don't apply
-                    mem = refreshed
+                    # folder-constrained refresh — an unconstrained get()
+                    # walks the whole store per memory (O(n^2) I/O)
+                    mem = self.store.get(mem.id, folder) or mem
             if acted:
                 stats["matched"] += 1
-            current = self.store.get(mem.id)
-            if current is not None and current.status == "new":
-                self.store.move(mem.id, current.folder, current.folder, "cur")
+            if not moved_away:
+                current = self.store.get(mem.id, folder)
+                if current is not None and current.status == "new":
+                    self.store.move(mem.id, folder, folder, "cur")
         return stats
 
 

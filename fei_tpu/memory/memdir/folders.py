@@ -51,13 +51,24 @@ class MemdirFolderManager:
         path = self.store.folder_path(name)
         if not os.path.isdir(path):
             return False
-        contents = (self.store.list(name, "new") + self.store.list(name, "cur"))
+        # include every nested subfolder: rmtree would destroy their
+        # memories too, so they all get rescued into .Trash
+        affected = [name] + [
+            f for f in self.store.list_folders() if f.startswith(name + "/")
+        ]
+        contents = [
+            (fld, mem)
+            for fld in affected
+            for status in ("new", "cur")
+            for mem in self.store.list(fld, status)
+        ]
         if contents and not force:
             raise MemoryError_(
-                f"folder {name} holds {len(contents)} memories; use force"
+                f"folder {name} holds {len(contents)} memories "
+                f"(incl. subfolders); use force"
             )
-        for mem in contents:  # preserve memories through forced deletes
-            self.store.move(mem.id, ".Trash", name)
+        for fld, mem in contents:  # preserve memories through forced deletes
+            self.store.move(mem.id, ".Trash", fld)
         shutil.rmtree(path)
         return True
 

@@ -173,7 +173,10 @@ def parse_search_args(query_string: str) -> SearchQuery:
         q.add("content", "regex", m.group(1))
         return " "
 
-    rest = re.sub(r"/((?:[^/\\]|\\.)+)/", grab_regex, query_string)
+    # a /regex/ must stand alone as a token — slashes inside field values
+    # (hierarchical folders like .Projects/Python) are not delimiters
+    rest = re.sub(r"(?:(?<=\s)|^)/((?:[^/\\]|\\.)+)/(?=\s|$)", grab_regex,
+                  query_string)
     for tok in rest.split():
         if tok == "with_content":
             q.with_content = True

@@ -182,13 +182,17 @@ class TestArchiver:
         year = time.localtime(time.time() - 120 * 86400).tm_year
         assert len(store.list(f".Archive/{year}", "cur")) == 1
 
-    def test_trash_expiry(self, store):
-        mem = store.save("short-lived")
-        store.delete(mem.id)  # to trash
-        trashed = store.get(mem.id)
-        self._backdate(store, trashed, 45)
-        removed = MemoryArchiver(store).empty_trash()
-        assert removed == 1 and store.get(mem.id) is None
+    def test_trash_expiry_counts_from_trashing_not_creation(self, store):
+        old = store.save("created long ago")
+        self._backdate(store, old, 120)
+        old = store.get(old.id)
+        store.delete(old.id)  # just moved to trash now
+        # same maintenance pass must NOT delete it: 0 days in trash
+        assert MemoryArchiver(store).empty_trash() == 0
+        assert store.get(old.id) is not None
+        # once it has sat in trash past trash_days it goes
+        removed = MemoryArchiver(store).empty_trash(now=time.time() + 45 * 86400)
+        assert removed == 1 and store.get(old.id) is None
 
     def test_rule_tag_trash(self, store):
         store.save("scratch", tags=["tmp"])
