@@ -259,15 +259,7 @@ class MemoryChain:
             return block
 
     def validate_chain(self, blocks: list[MemoryBlock] | None = None) -> bool:
-        blocks = blocks if blocks is not None else self.blocks
-        for i, block in enumerate(blocks):
-            if block.hash != block.calculate_hash():
-                return False
-            if i > 0 and block.previous_hash != blocks[i - 1].hash:
-                return False
-            if i > 0 and block.index != blocks[i - 1].index + 1:
-                return False
-        return True
+        return _validate_blocks(blocks if blocks is not None else self.blocks)
 
     def get_block(self, memory_id: str) -> MemoryBlock | None:
         for block in self.blocks:
@@ -496,3 +488,22 @@ class MemoryChain:
             "responsible": responsible,
             "valid": self.validate_chain(),
         }
+
+
+def _validate_blocks(blocks: list[MemoryBlock]) -> bool:
+    """Hash linkage + recomputed-hash validation shared by
+    MemoryChain.validate_chain and validate_block_dicts."""
+    for i, block in enumerate(blocks):
+        if block.hash != block.calculate_hash():
+            return False
+        if i > 0 and (block.previous_hash != blocks[i - 1].hash
+                      or block.index != blocks[i - 1].index + 1):
+            return False
+    return True
+
+
+def validate_block_dicts(chain: list[dict]) -> bool:
+    """Validate a serialized chain without constructing a MemoryChain — the
+    client-side fallback the reference's connector implements inline
+    (fei/tools/memorychain_connector.py:543-576)."""
+    return _validate_blocks([MemoryBlock.from_dict(d) for d in chain])

@@ -19,7 +19,7 @@ import re
 import threading
 from concurrent.futures import ThreadPoolExecutor
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from urllib.parse import parse_qs, urlparse
+from urllib.parse import parse_qs, unquote, urlparse
 
 from fei_tpu.memory.memorychain.chain import MemoryChain
 from fei_tpu.memory.memorychain.transport import HTTPTransport
@@ -99,11 +99,12 @@ class NodeAPI:
 
         m = re.match(r"^/memorychain/wallet/([^/]+)/transactions$", path)
         if m:
-            return 200, {"transactions": c.wallet.history(m.group(1))}
+            return 200, {"transactions": c.wallet.history(unquote(m.group(1)))}
         m = re.match(r"^/memorychain/wallet/([^/]+)$", path)
         if m:
-            return 200, {"node_id": m.group(1),
-                         "balance": c.wallet.balance(m.group(1))}
+            node_id = unquote(m.group(1))
+            return 200, {"node_id": node_id,
+                         "balance": c.wallet.balance(node_id)}
 
         if path == "/memorychain/register" and method == "POST":
             address = body.get("address", "")
@@ -127,7 +128,7 @@ class NodeAPI:
         m = re.match(r"^/memorychain/responsible/([^/]+)$", path)
         if m:
             return 200, {"memories": [b.to_dict()
-                                      for b in c.responsible_memories(m.group(1))]}
+                                      for b in c.responsible_memories(unquote(m.group(1)))]}
         if path == "/memorychain/stats":
             return 200, c.stats()
         if path == "/memorychain/node_status":

@@ -58,6 +58,11 @@ class MemoryError_(FeiError):
     shadowing the builtin)."""
 
 
+class ConnectionError_(MemoryError_):
+    """A memory service endpoint is unreachable (trailing underscore avoids
+    shadowing the builtin)."""
+
+
 class MCPError(FeiError):
     """MCP client/service failure."""
 
