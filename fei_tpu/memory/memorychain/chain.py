@@ -505,5 +505,10 @@ def _validate_blocks(blocks: list[MemoryBlock]) -> bool:
 def validate_block_dicts(chain: list[dict]) -> bool:
     """Validate a serialized chain without constructing a MemoryChain — the
     client-side fallback the reference's connector implements inline
-    (fei/tools/memorychain_connector.py:543-576)."""
-    return _validate_blocks([MemoryBlock.from_dict(d) for d in chain])
+    (fei/tools/memorychain_connector.py:543-576). Malformed block dicts make
+    the chain invalid, not an exception — the input is untrusted."""
+    try:
+        blocks = [MemoryBlock.from_dict(d) for d in chain]
+    except (TypeError, ValueError):
+        return False
+    return _validate_blocks(blocks)

@@ -97,7 +97,8 @@ class MemdirConnector:
                     and self._maybe_start_server()):
                 return self._make_request(method, path, params, body, _retry=False)
             if self.auto_start and _retry and method != "GET":
-                started = not self._port_in_use() and self._maybe_start_server()
+                started = (self._is_local and not self._port_in_use()
+                           and self._maybe_start_server())
                 if started:
                     return self._make_request(method, path, params, body,
                                               _retry=False)
@@ -110,6 +111,11 @@ class MemdirConnector:
     def _port(self) -> int:
         parsed = urllib.parse.urlparse(self.server_url)
         return parsed.port or 5000
+
+    @property
+    def _is_local(self) -> bool:
+        host = urllib.parse.urlparse(self.server_url).hostname
+        return host in ("127.0.0.1", "localhost", "::1")
 
     def _port_in_use(self) -> bool:
         with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
@@ -126,7 +132,11 @@ class MemdirConnector:
         return cmd
 
     def _maybe_start_server(self) -> bool:
-        """Spawn the server if the port is free; wait for /health."""
+        """Spawn the server if the URL is local and the port is free; never
+        auto-start for a remote server_url — a local replacement would be a
+        different (empty) store."""
+        if not self._is_local:
+            return False
         if self._server_proc is not None and self._server_proc.poll() is None:
             return self._wait_healthy(5.0)
         if self._port_in_use():

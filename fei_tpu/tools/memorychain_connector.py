@@ -104,6 +104,7 @@ class MemorychainConnector:
             "memory_id": uuid.uuid4().hex[:8],
             "headers": hdrs,
             "content": content,
+            "tags": tags or [],  # chain.stats() histograms read this field
         }
         out = self._request("POST", "/memorychain/propose",
                             body={"memory_data": memory_data})

@@ -79,9 +79,9 @@ class TestMemdirConnector:
     def test_search_query_language(self, memdir):
         memdir.create_memory("jax pjit notes", tags=["tpu"])
         memdir.create_memory("grocery list", tags=["home"])
-        out = memdir.search("#tpu")
+        out = memdir.search("#tpu", with_content=True)
         assert out["count"] == 1
-        assert "pjit" in out["results"][0]["headers"].get("Subject", "") or True
+        assert "pjit" in out["results"][0]["content"]
         out = memdir.search("grocery", with_content=True)
         assert out["count"] == 1
         assert "grocery" in out["results"][0]["content"]

@@ -1,0 +1,121 @@
+"""Memory tool suite tests: registry-dispatched handlers over a live
+in-process Memdir server, plus MemoryManager fan-out over both stores."""
+
+from __future__ import annotations
+
+import pytest
+
+from fei_tpu.memory.memdir.server import MemdirServer
+from fei_tpu.memory.memorychain.node import MemorychainNode
+from fei_tpu.tools.memdir_connector import MemdirConnector
+from fei_tpu.tools.memorychain_connector import MemorychainConnector
+from fei_tpu.tools.memory_tools import (
+    MEMORY_TOOL_DEFINITIONS,
+    MemoryManager,
+    create_memory_tools,
+)
+from fei_tpu.tools.registry import ToolRegistry
+
+
+@pytest.fixture()
+def memdir_server(tmp_path):
+    server = MemdirServer(base=str(tmp_path / "Memdir"), port=0, api_key="k")
+    server.start_background()
+    yield server
+    server.shutdown()
+
+
+@pytest.fixture()
+def registry(memdir_server):
+    reg = ToolRegistry()
+    conn = MemdirConnector(
+        server_url=f"http://127.0.0.1:{memdir_server.port}", api_key="k"
+    )
+    names = create_memory_tools(reg, conn)
+    assert len(names) == len(MEMORY_TOOL_DEFINITIONS) == 9
+    return reg
+
+
+class TestMemoryTools:
+    def test_all_tools_registered_with_schemas(self, registry):
+        for d in MEMORY_TOOL_DEFINITIONS:
+            assert d["name"] in registry.list_tools()
+        schemas = registry.get_schemas()
+        assert any(s["name"] == "memory_search" for s in schemas)
+
+    def test_create_then_search_via_registry(self, registry):
+        out = registry.execute_tool("memory_create", {
+            "content": "pallas flash attention tiling notes",
+            "subject": "pallas", "tags": "tpu,kernels", "flags": "F",
+        })
+        assert out["created"]
+        found = registry.execute_tool("memory_search", {
+            "query": "#kernels", "with_content": True,
+        })
+        assert found["count"] == 1
+        assert "tiling" in found["results"][0]["content"]
+
+    def test_view_list_delete(self, registry):
+        created = registry.execute_tool("memory_create", {"content": "temp note"})
+        mid = created["created"]
+        view = registry.execute_tool("memory_view", {"memory_id": mid})
+        assert view["content"] == "temp note"
+        listed = registry.execute_tool("memory_list", {"status": "new"})
+        assert listed["count"] >= 1
+        deleted = registry.execute_tool("memory_delete", {"memory_id": mid})
+        assert deleted["deleted"] is True
+
+    def test_search_by_tag_rewrites_query(self, registry):
+        registry.execute_tool("memory_create",
+                              {"content": "x", "tags": "solo"})
+        out = registry.execute_tool("memory_search_by_tag", {"tag": "#solo"})
+        assert out["count"] == 1
+
+    def test_validation_rejects_missing_required(self, registry):
+        from fei_tpu.utils.errors import ToolValidationError
+
+        with pytest.raises(ToolValidationError, match="content"):
+            registry.execute_tool("memory_create", {})
+
+    def test_server_status_tool(self, registry):
+        out = registry.execute_tool("memory_server_status", {})
+        assert out["running"] is True
+
+    def test_error_payload_not_exception(self, memdir_server):
+        reg = ToolRegistry()
+        conn = MemdirConnector(server_url="http://127.0.0.1:1", api_key="k")
+        create_memory_tools(reg, conn)
+        out = reg.execute_tool("memory_list", {})
+        assert "error" in out
+
+
+class TestMemoryManager:
+    def test_fanout_and_replication(self, memdir_server, tmp_path):
+        node = MemorychainNode(node_id="mm-node", port=0,
+                               base_dir=str(tmp_path / "chain"))
+        node.start_background()
+        try:
+            mgr = MemoryManager(
+                MemdirConnector(f"http://127.0.0.1:{memdir_server.port}",
+                                api_key="k"),
+                MemorychainConnector(node.address),
+            )
+            assert mgr.status() == {"memdir": True, "memorychain": True}
+            saved = mgr.save("shared fact about rope scaling",
+                             tags=["rope"], replicate=True, Subject="rope")
+            assert saved["memdir"] and saved["memorychain"]
+            out = mgr.search_all("rope scaling")
+            assert out["count"] >= 2  # found in both stores
+            assert not out["errors"]
+        finally:
+            node.shutdown()
+
+    def test_fanout_isolates_store_failure(self, memdir_server):
+        mgr = MemoryManager(
+            MemdirConnector(f"http://127.0.0.1:{memdir_server.port}", api_key="k"),
+            MemorychainConnector("http://127.0.0.1:1"),
+        )
+        mgr.memdir.create_memory("only in memdir please")
+        out = mgr.search_all("only in memdir")
+        assert len(out["memdir"]) == 1
+        assert "memorychain" in out["errors"]
