@@ -64,13 +64,15 @@ def build_assistant(args):
     registry = ToolRegistry()
     create_code_tools(registry)
     if getattr(args, "memory_tools", False):
-        try:
-            from fei_tpu.memory.tools import create_memory_tools
-        except ImportError as exc:
-            raise RuntimeError(
-                "memory tools are unavailable in this checkout"
-            ) from exc
+        from fei_tpu.tools.memory_tools import create_memory_tools
+
         create_memory_tools(registry)
+    try:
+        from fei_tpu.agent.mcp import MCPManager, register_mcp_tools
+
+        register_mcp_tools(registry, MCPManager())
+    except Exception as exc:  # noqa: BLE001 — MCP is optional at startup
+        log.warning("mcp tools unavailable: %s", exc)
     streamed: list[str] = []
     on_text = None
     if not getattr(args, "no_stream", False):
@@ -186,21 +188,25 @@ def handle_history_command(args) -> int:
 
 
 def handle_mcp_command(args) -> int:
-    try:
-        from fei_tpu.mcp import MCPManager
-    except ImportError:
-        print("error: MCP support is unavailable in this checkout", file=sys.stderr)
-        return 2
+    from fei_tpu.agent.mcp import MCPManager
 
     manager = MCPManager()
     if args.mcp_action == "list":
+        if not manager.client.servers:
+            print("no mcp servers configured (set FEI_TPU_MCP_SERVER_<NAME> "
+                  "or [mcp] server_<name> in the config file)")
         for name, spec in manager.client.servers.items():
-            kind = "stdio" if spec.get("command") else "http"
-            print(f"{name:20s} {kind:6s} {spec.get('url') or ' '.join(spec.get('command', []))}")
+            target = spec.url or " ".join(spec.command)
+            print(f"{name:20s} {spec.type:6s} {target}")
     elif args.mcp_action == "call":
+        if not args.service or not args.method:
+            print("usage: fei mcp call <service> <method> [--params JSON]",
+                  file=sys.stderr)
+            return 2
         params = json.loads(args.params) if args.params else {}
-        result = asyncio.run(manager.client.call_service(args.service, args.method, params))
+        result = manager.client.call_service(args.service, args.method, params)
         print(json.dumps(result, indent=2, default=str))
+    manager.close()
     return 0
 
 

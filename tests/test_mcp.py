@@ -1,0 +1,209 @@
+"""MCP client tests.
+
+Where the reference mocks subprocess.Popen with canned stdout lines
+(fei/tests/test_mcp.py:42-93), these tests spawn a REAL tiny JSON-RPC stdio
+server (a python -c script) and a real in-process HTTP JSON-RPC endpoint —
+exercising the actual pipe/reader-thread/process-group machinery.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from fei_tpu.agent.mcp import (
+    MCPClient,
+    MCPManager,
+    ProcessManager,
+    register_mcp_tools,
+)
+from fei_tpu.tools.registry import ToolRegistry
+from fei_tpu.utils.errors import MCPError
+
+# A minimal stdio JSON-RPC server: echoes method/params back as the result;
+# method "sleep" never answers (for timeout tests); method "boom" errors.
+STDIO_SERVER = r"""
+import json, sys
+for line in sys.stdin:
+    req = json.loads(line)
+    m = req["method"]
+    if m == "sleep":
+        continue
+    if m == "boom":
+        out = {"jsonrpc": "2.0", "id": req["id"], "error": {"code": -1, "message": "boom"}}
+    else:
+        out = {"jsonrpc": "2.0", "id": req["id"],
+               "result": {"method": m, "params": req.get("params", {})}}
+    sys.stdout.write(json.dumps(out) + "\n")
+    sys.stdout.flush()
+"""
+
+STDIO_CMD = [sys.executable, "-u", "-c", STDIO_SERVER]
+
+
+@pytest.fixture()
+def client(monkeypatch):
+    monkeypatch.setenv("FEI_TPU_MCP_SERVER_ECHO",
+                       " ".join([sys.executable, "-u", "-c", repr(STDIO_SERVER)]))
+    c = MCPClient(process_manager=ProcessManager())
+    # env-spec round-trips through shlex; register directly for reliability
+    c.add_stdio_server("echo", STDIO_CMD)
+    yield c
+    c.close()
+
+
+class TestStdio:
+    def test_roundtrip(self, client):
+        out = client.call_service("echo", "hello", {"x": 1})
+        assert out == {"method": "hello", "params": {"x": 1}}
+
+    def test_concurrent_requests_route_by_id(self, client):
+        results = {}
+
+        def call(i):
+            results[i] = client.call_service("echo", f"m{i}", {"i": i})
+
+        threads = [threading.Thread(target=call, args=(i,)) for i in range(8)]
+        [t.start() for t in threads]
+        [t.join() for t in threads]
+        for i in range(8):
+            assert results[i]["params"] == {"i": i}
+
+    def test_error_response_raises(self, client):
+        with pytest.raises(MCPError, match="boom"):
+            client.call_service("echo", "boom")
+
+    def test_timeout(self, client):
+        with pytest.raises(MCPError, match="timed out"):
+            client.call_service("echo", "sleep", timeout=0.3)
+        # server still usable afterwards
+        assert client.call_service("echo", "ok")["method"] == "ok"
+
+    def test_stop_and_restart(self, client):
+        client.call_service("echo", "warm")
+        assert client.stop_server("echo") is True
+        # next call restarts the process transparently
+        assert client.call_service("echo", "again")["method"] == "again"
+
+    def test_child_death_fails_inflight_calls_fast(self, client):
+        import time
+
+        client.call_service("echo", "warm")
+        proc = client.processes.get("echo")
+        results = []
+
+        def call():
+            t0 = time.time()
+            try:
+                client.call_service("echo", "sleep", timeout=30.0)
+            except MCPError as exc:
+                results.append((time.time() - t0, str(exc)))
+
+        t = threading.Thread(target=call)
+        t.start()
+        time.sleep(0.3)
+        proc.proc.kill()  # child dies with the call in flight
+        t.join(timeout=5)
+        assert results, "in-flight call never returned"
+        elapsed, message = results[0]
+        assert elapsed < 5, f"took {elapsed:.1f}s — waited out the timeout"
+        assert "exited" in message
+
+    def test_unknown_service(self, client):
+        with pytest.raises(MCPError, match="unknown mcp service"):
+            client.call_service("nope", "m")
+
+    def test_env_config_registers_server(self, monkeypatch):
+        monkeypatch.setenv("FEI_TPU_MCP_SERVER_FOO", "http://127.0.0.1:9/rpc")
+        c = MCPClient(process_manager=ProcessManager())
+        assert "foo" in c.list_services()
+        assert c.servers["foo"].type == "http"
+
+
+class _RPCHandler(BaseHTTPRequestHandler):
+    def do_POST(self):
+        req = json.loads(self.rfile.read(int(self.headers["Content-Length"])))
+        if req["method"] == "fail":
+            out = {"jsonrpc": "2.0", "id": req["id"],
+                   "error": {"message": "http fail"}}
+        else:
+            out = {"jsonrpc": "2.0", "id": req["id"],
+                   "result": {"echo": req["method"]}}
+        data = json.dumps(out).encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def log_message(self, *a):
+        pass
+
+
+@pytest.fixture()
+def http_rpc():
+    server = ThreadingHTTPServer(("127.0.0.1", 0), _RPCHandler)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{server.server_address[1]}/rpc"
+    server.shutdown()
+
+
+class TestHTTP:
+    def test_http_roundtrip(self, http_rpc):
+        c = MCPClient(process_manager=ProcessManager())
+        c.add_http_server("svc", http_rpc)
+        assert c.call_service("svc", "ping") == {"echo": "ping"}
+
+    def test_http_error(self, http_rpc):
+        c = MCPClient(process_manager=ProcessManager())
+        c.add_http_server("svc", http_rpc)
+        with pytest.raises(MCPError, match="http fail"):
+            c.call_service("svc", "fail")
+
+    def test_invalid_url_rejected(self):
+        c = MCPClient(process_manager=ProcessManager())
+        with pytest.raises(MCPError, match="invalid"):
+            c.add_http_server("bad", "http://")
+
+
+class TestServicesAndRegistry:
+    def test_memory_service_methods(self, http_rpc):
+        mgr = MCPManager()
+        mgr.client.add_http_server("memory", http_rpc)
+        assert mgr.memory.available()
+        assert mgr.memory.read_graph() == {"echo": "read_graph"}
+        assert mgr.memory.search_nodes("q") == {"echo": "search_nodes"}
+        assert mgr.memory.create_entities([{"name": "a"}]) == {"echo": "create_entities"}
+
+    def test_fetch_service(self, http_rpc):
+        mgr = MCPManager()
+        mgr.client.add_http_server("fetch", http_rpc)
+        assert mgr.fetch.fetch("http://example.com") == {"echo": "fetch"}
+
+    def test_passthrough_dispatch(self, http_rpc):
+        mgr = MCPManager()
+        mgr.client.add_http_server("memory", http_rpc)
+        reg = ToolRegistry()
+        register_mcp_tools(reg, mgr)
+        out = reg.execute_tool("mcp_memory_search_nodes", {"query": "x"})
+        assert out == {"echo": "search_nodes"}
+        out = reg.execute_tool("mcp_unknown_svc_method", {})
+        assert "error" in out
+
+    def test_brave_fallback_no_key_is_error_payload(self, monkeypatch):
+        monkeypatch.delenv("BRAVE_API_KEY", raising=False)
+        mgr = MCPManager()  # no brave_search server configured
+        mgr.brave_search.api_key = ""
+        reg = ToolRegistry()
+        register_mcp_tools(reg, mgr)
+        out = reg.execute_tool("brave_web_search", {"query": "anything"})
+        assert "error" in out
+
+    def test_github_service_shapes(self, http_rpc):
+        mgr = MCPManager()
+        mgr.client.add_http_server("github", http_rpc)
+        assert mgr.github.search_repositories("jax")["echo"] == "search_repositories"
+        assert mgr.github.get_file_contents("o", "r", "p")["echo"] == "get_file_contents"
