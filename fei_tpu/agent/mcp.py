@@ -74,6 +74,7 @@ class _StdioProcess:
         )
         self._pending: dict[int, queue.Queue] = {}
         self._lock = threading.Lock()
+        self._write_lock = threading.Lock()
         self._next_id = 0
         self._reader = threading.Thread(target=self._read_loop, daemon=True)
         self._reader.start()
@@ -119,8 +120,12 @@ class _StdioProcess:
                    "params": params or {}}
         try:
             assert self.proc.stdin is not None
-            self.proc.stdin.write(json.dumps(request) + "\n")
-            self.proc.stdin.flush()
+            # registry handlers run in a thread pool, so concurrent calls are
+            # normal; serialize write+flush or large payloads interleave
+            # mid-line once they exceed the BufferedWriter capacity
+            with self._write_lock:
+                self.proc.stdin.write(json.dumps(request) + "\n")
+                self.proc.stdin.flush()
         except (BrokenPipeError, OSError) as exc:
             with self._lock:
                 self._pending.pop(rid, None)
@@ -444,7 +449,9 @@ def make_mcp_dispatcher(manager: MCPManager):
 
     def dispatch(name: str, args: dict):
         rest = name[len("mcp_"):]
-        for service in manager.list_services():
+        # longest name first: with services 'brave' and 'brave_search'
+        # configured, mcp_brave_search_web_search must hit 'brave_search'
+        for service in sorted(manager.list_services(), key=len, reverse=True):
             if rest.startswith(service + "_"):
                 method = rest[len(service) + 1:]
                 try:

@@ -1,3 +1,25 @@
 from fei_tpu.engine.engine import InferenceEngine, GenerationConfig
+from fei_tpu.engine.grammar import (
+    JsonSchemaGrammar,
+    TokenGrammar,
+    compile_tool_call_grammar,
+)
+from fei_tpu.engine.paged_cache import PagedKVCache, PageAllocator
+from fei_tpu.engine.checkpoint import (
+    save_checkpoint,
+    restore_checkpoint,
+    latest_step,
+)
 
-__all__ = ["InferenceEngine", "GenerationConfig"]
+__all__ = [
+    "save_checkpoint",
+    "restore_checkpoint",
+    "latest_step",
+    "InferenceEngine",
+    "GenerationConfig",
+    "JsonSchemaGrammar",
+    "TokenGrammar",
+    "compile_tool_call_grammar",
+    "PagedKVCache",
+    "PageAllocator",
+]

@@ -1,0 +1,115 @@
+"""Model/optimizer/cache checkpointing via orbax.
+
+The reference checkpoints conversations and ledgers but never model state
+(SURVEY.md §5 — its "models" live behind HTTP). Here training and long-lived
+decode state are local device pytrees, so real checkpointing is required:
+
+- step-numbered directories with retention (CheckpointManager)
+- composite save: params / opt_state / KV cache / arbitrary metadata in one
+  atomic step
+- **sharded restore**: pass the target mesh's NamedShardings and each array
+  is restored directly into its shard layout (no host-RAM staging of the
+  full model, which a v5e-64 70B restore could not afford)
+
+All functions are thin over ``orbax.checkpoint``; the value is the fixed
+layout contract shared by train.py, the engine, and the CLI's resume path.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import jax
+
+from fei_tpu.utils.errors import CheckpointError
+from fei_tpu.utils.logging import get_logger
+
+log = get_logger("engine.checkpoint")
+
+
+def _manager(directory: str, max_to_keep: int | None = 3):
+    import orbax.checkpoint as ocp
+
+    return ocp.CheckpointManager(
+        os.path.abspath(directory),
+        options=ocp.CheckpointManagerOptions(
+            max_to_keep=max_to_keep, create=True
+        ),
+    )
+
+
+def save_checkpoint(
+    directory: str,
+    step: int,
+    params: Any,
+    opt_state: Any = None,
+    cache: Any = None,
+    max_to_keep: int | None = 3,
+) -> None:
+    """Atomically save a composite checkpoint at ``step``.
+
+    Only non-None components are written; restore_checkpoint returns the
+    same composite shape.
+    """
+    import orbax.checkpoint as ocp
+
+    tree: dict[str, Any] = {"params": params}
+    if opt_state is not None:
+        tree["opt_state"] = opt_state
+    if cache is not None:
+        tree["cache"] = cache
+    mgr = _manager(directory, max_to_keep)
+    try:
+        mgr.save(step, args=ocp.args.StandardSave(tree))
+        mgr.wait_until_finished()
+    finally:
+        mgr.close()
+    log.info("saved checkpoint step=%d -> %s", step, directory)
+
+
+def latest_step(directory: str) -> int | None:
+    mgr = _manager(directory)
+    try:
+        return mgr.latest_step()
+    finally:
+        mgr.close()
+
+
+def restore_checkpoint(
+    directory: str,
+    step: int | None = None,
+    target: Any = None,
+    shardings: Any = None,
+) -> dict[str, Any]:
+    """Restore a composite checkpoint.
+
+    - ``step=None`` restores the latest step.
+    - ``target``: a pytree of arrays (or ShapeDtypeStructs) matching what was
+      saved; required for exact dtype/shape restoration and for sharded
+      restore. Without it, orbax restores as host numpy arrays.
+    - ``shardings``: optional pytree of NamedShardings congruent with
+      ``target`` — arrays land directly in that layout on the mesh.
+    """
+    import orbax.checkpoint as ocp
+
+    mgr = _manager(directory)
+    try:
+        if step is None:
+            step = mgr.latest_step()
+        if step is None:
+            raise CheckpointError(f"no checkpoints found under {directory}")
+        if target is not None:
+            abstract = jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), target
+            )
+            if shardings is not None:
+                abstract = jax.tree.map(
+                    lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s),
+                    abstract,
+                    shardings,
+                )
+            return mgr.restore(step, args=ocp.args.StandardRestore(abstract))
+        return mgr.restore(step)
+    finally:
+        mgr.close()

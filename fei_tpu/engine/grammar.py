@@ -1,0 +1,401 @@
+"""Grammar-constrained decoding: JSON schema → character DFA → token masks.
+
+The agent's tool calls must be valid JSON matching each tool's input schema
+(the reference trusts the remote LLM and then validates after the fact,
+fei/tools/registry.py:92-153; here the local decoder *cannot emit* an
+invalid call in the first place). Pipeline:
+
+  schema ──compile──▶ char-level DFA (states × 256 bytes)
+         ──lift────▶ token-level transition table (states × vocab)
+         ──decode──▶ per-step boolean logit mask for the engine's
+                      ``logit_mask_fn`` hook (engine.py generate_stream)
+
+Schema subset (what tool-call arguments actually use — definitions.py):
+object with ordered properties, string, integer, number, boolean, null,
+enum of strings, arrays of any supported type, nested objects. Objects are
+emitted compact (no whitespace) with properties in schema order — the
+grammar governs *generation*, not parsing, so fixing the order costs
+nothing and keeps the DFA small.
+
+The token table is a dense int32 [n_states, vocab] array (-1 = forbidden),
+so each decode step is two O(1) lookups; as device arrays the same tables
+support a fully on-device constrained scan (mask = table[state] >= 0,
+state' = table[state, token]) with no per-token host round-trip.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import numpy as np
+
+from fei_tpu.utils.errors import EngineError
+
+_ESCAPES = b'"\\/bfnrt'
+_DIGITS = b"0123456789"
+
+
+class _DFA:
+    """Mutable char-level DFA under construction.
+
+    Each state is a dict byte→state. ``also[s]`` marks a lower-precedence
+    fallback state whose transitions apply where s has none (used for value
+    states like numbers that terminate on whatever char *follows* them).
+    ``default[s]`` catches all bytes not in the dict (string bodies).
+    """
+
+    def __init__(self):
+        self.trans: list[dict[int, int]] = []
+        self.also: list[int | None] = []
+        self.default: list[int | None] = []
+
+    def new_state(self) -> int:
+        self.trans.append({})
+        self.also.append(None)
+        self.default.append(None)
+        return len(self.trans) - 1
+
+    def lit(self, text: bytes, nxt: int) -> int:
+        """Chain of literal bytes ending at ``nxt`` (built backwards)."""
+        for b in reversed(text):
+            s = self.new_state()
+            self.trans[s][b] = nxt
+            nxt = s
+        return nxt
+
+    def char_table(self) -> np.ndarray:
+        """Resolve also/default into a dense [n_states, 256] int32 table."""
+        n = len(self.trans)
+        table = np.full((n, 256), -1, dtype=np.int32)
+        for s in range(n):
+            if self.default[s] is not None:
+                table[s, :] = self.default[s]
+                # control chars are never legal raw in JSON strings
+                table[s, :0x20] = -1
+            # walk the also-chain lowest precedence first
+            chain = []
+            cur = self.also[s]
+            while cur is not None:
+                chain.append(cur)
+                cur = self.also[cur]
+            for fb in reversed(chain):
+                for b, t in self.trans[fb].items():
+                    table[s, b] = t
+            for b, t in self.trans[s].items():
+                table[s, b] = t
+        return table
+
+
+class JsonSchemaGrammar:
+    """Compile a JSON schema into a char DFA with entry/accept states."""
+
+    def __init__(self, schema: dict):
+        self.schema = schema
+        self.dfa = _DFA()
+        self.accept = self.dfa.new_state()  # state 0: generation complete
+        self.entry = self._value(schema, self.accept)
+        self.char_table = self.dfa.char_table()
+
+    # each _X(schema, nxt) returns the entry state, built back-to-front
+
+    def _value(self, schema: dict, nxt: int) -> int:
+        if "enum" in schema:
+            return self._enum(schema["enum"], nxt)
+        t = schema.get("type", "object")
+        if isinstance(t, list):  # e.g. ["string", "null"]
+            return self._union([{**schema, "type": ti} for ti in t], nxt)
+        builder = {
+            "object": self._object,
+            "string": self._string,
+            "integer": self._number,
+            "number": self._number,
+            "boolean": self._boolean,
+            "null": self._null,
+            "array": self._array,
+        }.get(t)
+        if builder is None:
+            raise EngineError(f"unsupported schema type: {t!r}")
+        if t == "integer":
+            return self._number({**schema, "_integer": True}, nxt)
+        return builder(schema, nxt)
+
+    def _union(self, schemas: list[dict], nxt: int) -> int:
+        entry = self.dfa.new_state()
+        for sub in schemas:
+            e = self._value(sub, nxt)
+            for b, t in self.dfa.trans[e].items():
+                self.dfa.trans[entry].setdefault(b, t)
+            if self.dfa.default[e] is not None and self.dfa.default[entry] is None:
+                self.dfa.default[entry] = self.dfa.default[e]
+        return entry
+
+    def _object(self, schema: dict, nxt: int) -> int:
+        props: dict = schema.get("properties", {})
+        if not props:
+            return self.dfa.lit(b"{}", nxt)
+        close = self.dfa.lit(b"}", nxt)
+        cur = close
+        items = list(props.items())
+        for i, (key, sub) in enumerate(reversed(items)):
+            first = i == len(items) - 1
+            prefix = b'{"' if first else b',"'
+            value_entry = self._value(sub, cur)
+            cur = self.dfa.lit(prefix + key.encode("utf-8") + b'":', value_entry)
+        return cur
+
+    def _string(self, schema: dict, nxt: int) -> int:
+        body = self.dfa.new_state()
+        esc = self.dfa.new_state()
+        self.dfa.default[body] = body
+        self.dfa.trans[body][0x22] = nxt  # closing "
+        self.dfa.trans[body][0x5C] = esc  # backslash
+        for b in _ESCAPES:
+            self.dfa.trans[esc][b] = body
+        open_q = self.dfa.new_state()
+        self.dfa.trans[open_q][0x22] = body
+        return open_q
+
+    def _number(self, schema: dict, nxt: int) -> int:
+        integer_only = schema.get("_integer", False)
+        # digit-loop states terminate via fallback on whatever follows
+        int_loop = self.dfa.new_state()
+        for b in _DIGITS:
+            self.dfa.trans[int_loop][b] = int_loop
+        self.dfa.also[int_loop] = nxt
+        # JSON forbids leading zeros: a leading '0' may only be followed by
+        # '.' (or terminate) — never another digit
+        zero = self.dfa.new_state()
+        self.dfa.also[zero] = nxt
+        if not integer_only:
+            frac_loop = self.dfa.new_state()
+            for b in _DIGITS:
+                self.dfa.trans[frac_loop][b] = frac_loop
+            self.dfa.also[frac_loop] = nxt
+            frac_first = self.dfa.new_state()
+            for b in _DIGITS:
+                self.dfa.trans[frac_first][b] = frac_loop
+            self.dfa.trans[int_loop][0x2E] = frac_first  # '.'
+            self.dfa.trans[zero][0x2E] = frac_first
+        first_digit = self.dfa.new_state()  # after '-'
+        for b in _DIGITS[1:]:
+            self.dfa.trans[first_digit][b] = int_loop
+        self.dfa.trans[first_digit][ord("0")] = zero
+        entry = self.dfa.new_state()
+        for b in _DIGITS[1:]:
+            self.dfa.trans[entry][b] = int_loop
+        self.dfa.trans[entry][ord("0")] = zero
+        self.dfa.trans[entry][0x2D] = first_digit  # '-'
+        return entry
+
+    def _boolean(self, schema: dict, nxt: int) -> int:
+        t = self.dfa.lit(b"rue", nxt)
+        f = self.dfa.lit(b"alse", nxt)
+        entry = self.dfa.new_state()
+        self.dfa.trans[entry][ord("t")] = t
+        self.dfa.trans[entry][ord("f")] = f
+        return entry
+
+    def _null(self, schema: dict, nxt: int) -> int:
+        return self.dfa.lit(b"null", nxt)
+
+    def _enum(self, values: list, nxt: int) -> int:
+        # explicit trie over the JSON encodings, then materialize: a node
+        # that ends a value AND continues a longer one keeps its child edges
+        # with ``nxt`` as fallback (also), so prefix pairs like 1 / 12 both
+        # stay generatable and nothing illegal (e.g. 1222) sneaks through
+        import json as _json
+
+        trie: dict = {}
+        TERM = object()
+        for val in values:
+            node = trie
+            for b in _json.dumps(val).encode("utf-8"):
+                node = node.setdefault(b, {})
+            node[TERM] = True
+
+        def materialize(node: dict) -> int:
+            children = {b: c for b, c in node.items() if b is not TERM}
+            terminal = TERM in node
+            if terminal and not children:
+                return nxt
+            s = self.dfa.new_state()
+            for b, child in children.items():
+                self.dfa.trans[s][b] = materialize(child)
+            if terminal:
+                self.dfa.also[s] = nxt
+            return s
+
+        return materialize(trie)
+
+    def _array(self, schema: dict, nxt: int) -> int:
+        item_schema = schema.get("items", {"type": "string"})
+        # sep: after an item -> ',' item | ']' end. Allocate first, fill after
+        sep = self.dfa.new_state()
+        item_entry = self._value(item_schema, sep)
+        self.dfa.trans[sep][0x2C] = item_entry  # ','
+        self.dfa.trans[sep][0x5D] = nxt  # ']'
+        entry = self.dfa.new_state()
+        self.dfa.trans[entry][0x5B] = 0  # placeholder, set below
+        first = self.dfa.new_state()
+        # first position: either an item or an immediate close
+        for b, t in self.dfa.trans[item_entry].items():
+            self.dfa.trans[first][b] = t
+        if self.dfa.default[item_entry] is not None:
+            self.dfa.default[first] = self.dfa.default[item_entry]
+        self.dfa.trans[first][0x5D] = nxt
+        self.dfa.trans[entry][0x5B] = first
+        return entry
+
+
+def _token_text(tokenizer, tid: int) -> str | None:
+    """A token's *in-context* text.
+
+    ``decode([tid])`` alone is wrong for sentencepiece/BPE vocabs: a token
+    whose true text is " true" decodes standalone as "true", so the DFA
+    would validate different bytes than the detokenizer later emits. For HF
+    tokenizers we decode behind an anchor token and take the suffix, which
+    preserves leading spaces exactly as they will appear in real output.
+    """
+    hf = getattr(tokenizer, "_tok", None)
+    try:
+        if hf is None:
+            return tokenizer.decode([tid]) or None
+        anchor = hf.encode(":", add_special_tokens=False)
+        if not anchor:
+            return hf.decode([tid], skip_special_tokens=True) or None
+        base = hf.decode([anchor[0]], skip_special_tokens=True)
+        ctx = hf.decode([anchor[0], tid], skip_special_tokens=True)
+        if ctx.startswith(base):
+            return ctx[len(base):] or None
+        return hf.decode([tid], skip_special_tokens=True) or None
+    except Exception:
+        return None
+
+
+class TokenGrammar:
+    """Token-level lift of a JsonSchemaGrammar for a concrete tokenizer.
+
+    Builds [n_states, vocab] transition (int32, -1 = forbidden) and mask
+    (bool) tables. Works with any tokenizer exposing ``decode([id])``;
+    multi-byte tokens walk the char DFA transitively.
+    """
+
+    def __init__(self, grammar: JsonSchemaGrammar, tokenizer):
+        self.grammar = grammar
+        self.tokenizer = tokenizer
+        char_tab = grammar.char_table
+        n_states = char_tab.shape[0]
+        V = tokenizer.vocab_size
+        table = np.full((n_states, V), -1, dtype=np.int32)
+
+        token_bytes: list[bytes | None] = []
+        for tid in range(V):
+            text = _token_text(tokenizer, tid)
+            bs = text.encode("utf-8") if text else b""
+            token_bytes.append(bs if bs else None)
+
+        for tid, bs in enumerate(token_bytes):
+            if bs is None:
+                continue
+            # vectorized walk over start states
+            states = np.arange(n_states, dtype=np.int32)
+            for b in bs:
+                valid = states >= 0
+                states = np.where(valid, char_tab[np.maximum(states, 0), b], -1)
+            table[:, tid] = states
+
+        # stop tokens are allowed in every *accepting* state: the accept
+        # state itself plus any state whose also-fallback chain reaches it
+        # (e.g. a top-level number's digit loop, which terminates on
+        # "whatever follows" — at top level that is end-of-output)
+        accept = grammar.accept
+        table[accept, :] = -1
+        accepting = {accept}
+        for s in range(n_states):
+            cur = grammar.dfa.also[s]
+            while cur is not None:
+                if cur == accept:
+                    accepting.add(s)
+                    break
+                cur = grammar.dfa.also[cur]
+        for s in accepting:
+            for sid in tokenizer.stop_token_ids:
+                table[s, sid] = accept
+        self.accepting_states = accepting
+        self.table = table
+        self.mask_table = table >= 0
+        self.entry = grammar.entry
+        self.accept = grammar.accept
+        self.min_dist = self._min_distances()
+
+    def _min_distances(self) -> np.ndarray:
+        """min_dist[s] = fewest tokens from state s to the accept state.
+
+        Used for forced completion: when the remaining budget hits this
+        distance, the mask is tightened to distance-decreasing tokens only,
+        so constrained generation always closes its braces before the token
+        budget runs out. unreachable states get a large sentinel."""
+        n = self.table.shape[0]
+        INF = np.int32(1 << 20)
+        dist = np.full(n, INF, dtype=np.int32)
+        dist[self.accept] = 0
+        # Bellman-Ford over the token graph (n_states is small)
+        for _ in range(n):
+            tgt = np.where(self.table >= 0, self.table, 0)
+            tgt_dist = np.where(self.table >= 0, dist[tgt], INF)
+            best = tgt_dist.min(axis=1)
+            new = np.minimum(dist, np.where(best >= INF, INF, best + 1))
+            if np.array_equal(new, dist):
+                break
+            dist = new
+        return dist
+
+    def walk(self, token_ids: list[int]) -> int:
+        """State after consuming ``token_ids`` from entry; -1 if rejected."""
+        s = self.entry
+        for t in token_ids:
+            if s < 0:
+                return -1
+            s = int(self.table[s, t])
+        return s
+
+    def logit_mask_fn(
+        self, max_tokens: int | None = None
+    ) -> Callable[[list[int]], np.ndarray | None]:
+        """Adapter for InferenceEngine.generate_stream(logit_mask_fn=…).
+
+        Incremental: caches the DFA state per prefix length so each step is
+        one table lookup, not a re-walk. With ``max_tokens`` set, forces
+        completion: once the remaining budget equals the shortest path to
+        accept, only distance-decreasing tokens stay legal.
+        """
+        state = {"len": 0, "s": self.entry}
+
+        def fn(generated: list[int]) -> np.ndarray | None:
+            if len(generated) < state["len"]:  # new generation / reset
+                state["len"], state["s"] = 0, self.entry
+            for t in generated[state["len"]:]:
+                state["s"] = int(self.table[state["s"], t]) if state["s"] >= 0 else -1
+            state["len"] = len(generated)
+            s = state["s"]
+            if s < 0:
+                return None  # constraint already violated; stop masking
+            mask = self.mask_table[s]
+            if max_tokens is not None:
+                # budget feasibility per edge: a token is only legal if its
+                # target can still reach accept within the remaining budget.
+                # Inductively dist[s] <= remaining, so the shortest-path edge
+                # always survives — generation can never strand mid-grammar.
+                remaining = max_tokens - len(generated)
+                tgt = np.where(self.table[s] >= 0, self.table[s], 0)
+                feasible = mask & (self.min_dist[tgt] <= remaining - 1)
+                if feasible.any():
+                    mask = feasible
+            return mask
+
+        return fn
+
+
+def compile_tool_call_grammar(tool_schema: dict, tokenizer) -> TokenGrammar:
+    """Compile one tool's JSON-schema ``input_schema`` into token tables."""
+    return TokenGrammar(JsonSchemaGrammar(tool_schema), tokenizer)

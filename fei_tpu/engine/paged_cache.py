@@ -1,0 +1,218 @@
+"""Paged KV cache: page pool + block tables + host-side allocator.
+
+The contiguous KVCache (models/llama.py) reserves max_seq_len slots per
+sequence up front. Agent task loops grow context monotonically and unevenly
+(reference: fei/core/task_executor.py:231-252, conversation never trimmed),
+so contiguous reservation wastes HBM proportional to (max_seq - actual) per
+sequence. The paged layout allocates fixed-size pages from a shared pool as
+sequences grow, indirected by a per-sequence block table — the design from
+the ragged-paged-attention literature (PAPERS.md #1), realized here with the
+Pallas decode kernel (fei_tpu.ops.pallas.paged_attention).
+
+Layouts (L=layers, P=pool pages, K=kv heads, ps=page size, D=head dim):
+  k_pages/v_pages: [L, P, K, ps, D]   (head-major pages — kernel layout)
+  block_table:     [B, max_pages]     int32 page ids, row-ragged
+  lengths:         [B]                int32 valid token count
+
+The allocator is deliberately host-side Python (free-list): allocation
+happens once per prefill and at page boundaries during decode, never inside
+a jitted program.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from fei_tpu.models.configs import ModelConfig
+from fei_tpu.ops.attention import attention
+from fei_tpu.utils.errors import EngineError
+
+
+class PagedKVCache(NamedTuple):
+    k_pages: jnp.ndarray  # [L, P, K, ps, D]
+    v_pages: jnp.ndarray  # [L, P, K, ps, D]
+    block_table: jnp.ndarray  # [B, max_pages] int32
+    lengths: jnp.ndarray  # [B] int32
+
+    @property
+    def page_size(self) -> int:
+        return self.k_pages.shape[3]
+
+    @classmethod
+    def create(
+        cls,
+        cfg: ModelConfig,
+        num_pages: int,
+        batch: int,
+        max_pages_per_seq: int,
+        page_size: int = 64,
+        dtype=jnp.bfloat16,
+    ) -> "PagedKVCache":
+        shape = (cfg.num_layers, num_pages, cfg.num_kv_heads, page_size, cfg.head_dim_)
+        return cls(
+            k_pages=jnp.zeros(shape, dtype=dtype),
+            v_pages=jnp.zeros(shape, dtype=dtype),
+            block_table=jnp.zeros((batch, max_pages_per_seq), dtype=jnp.int32),
+            lengths=jnp.zeros((batch,), dtype=jnp.int32),
+        )
+
+
+class PageAllocator:
+    """Free-list page allocator over a pool of ``num_pages`` pages.
+
+    Page 0 is reserved as the null page (block-table padding points there),
+    mirroring the null-block convention of paged-attention servers.
+    """
+
+    def __init__(self, num_pages: int, page_size: int):
+        self.num_pages = num_pages
+        self.page_size = page_size
+        self._free = list(range(num_pages - 1, 0, -1))  # pop() yields 1, 2, …
+        self._owned: dict[int, list[int]] = {}
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    def pages_for(self, seq_id: int) -> list[int]:
+        return list(self._owned.get(seq_id, []))
+
+    def alloc(self, seq_id: int, n: int, contiguous: bool = False) -> list[int]:
+        """Allocate n pages for a sequence. ``contiguous=True`` requires (and
+        returns) an ascending run — used at prefill so the dense→paged copy
+        is one dynamic_update_slice per sequence."""
+        if n > len(self._free):
+            raise EngineError(
+                f"paged KV pool exhausted: need {n} pages, {len(self._free)} free"
+            )
+        if contiguous:
+            run = self._find_run(n)
+            if run is None:
+                raise EngineError(
+                    f"paged KV pool fragmented: no contiguous run of {n} pages"
+                )
+            for p in run:
+                self._free.remove(p)
+            got = run
+        else:
+            got = [self._free.pop() for _ in range(n)]
+        self._owned.setdefault(seq_id, []).extend(got)
+        return got
+
+    def _find_run(self, n: int) -> list[int] | None:
+        free = sorted(self._free)
+        run: list[int] = []
+        for p in free:
+            if run and p == run[-1] + 1:
+                run.append(p)
+            else:
+                run = [p]
+            if len(run) == n:
+                return run
+        return None
+
+    def free(self, seq_id: int) -> None:
+        self._free.extend(reversed(self._owned.pop(seq_id, [])))
+
+    def pages_needed(self, tokens: int) -> int:
+        return -(-tokens // self.page_size)
+
+
+def build_block_table(
+    page_lists: list[list[int]], max_pages: int
+) -> jnp.ndarray:
+    """Host page lists → padded [B, max_pages] device table (null page 0)."""
+    rows = []
+    for pages in page_lists:
+        if len(pages) > max_pages:
+            raise EngineError(
+                f"sequence owns {len(pages)} pages > table width {max_pages}"
+            )
+        rows.append(list(pages) + [0] * (max_pages - len(pages)))
+    return jnp.asarray(rows, dtype=jnp.int32)
+
+
+def dense_to_pages(
+    paged: PagedKVCache,
+    k_dense: jnp.ndarray,  # [L, B, S, K, D] (contiguous prefill cache)
+    v_dense: jnp.ndarray,
+    lengths: jnp.ndarray,  # [B] true prompt lengths
+    start_pages: jnp.ndarray,  # [B] first page of each seq's contiguous run
+) -> PagedKVCache:
+    """Copy a dense prefill cache into the page pool.
+
+    Each sequence's prompt pages were allocated contiguously, so the copy is
+    a reshape + one dynamic_update_slice per sequence (no per-token scatter).
+    Rounds each sequence up to whole pages; the tail garbage is masked by
+    ``lengths`` in the kernel. jit-friendly (the engine jits this with the
+    pool donated, so prefill never holds two copies of the pool in HBM).
+    """
+    L, B, S, K, D = k_dense.shape
+    ps = paged.page_size
+    if S % ps:
+        pad = ps - S % ps
+        k_dense = jnp.pad(k_dense, ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0)))
+        v_dense = jnp.pad(v_dense, ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0)))
+        S += pad
+    n = S // ps
+
+    # [L, B, n, ps, K, D] -> [B, L, n, K, ps, D]
+    def to_pages(dense):
+        x = dense.reshape(L, B, n, ps, K, D)
+        return jnp.transpose(x, (1, 0, 2, 4, 3, 5))
+
+    kp, vp = to_pages(k_dense), to_pages(v_dense)
+    k_pool, v_pool = paged.k_pages, paged.v_pages
+    for b in range(B):
+        at = (0, start_pages[b], 0, 0, 0)
+        k_pool = jax.lax.dynamic_update_slice(k_pool, kp[b].astype(k_pool.dtype), at)
+        v_pool = jax.lax.dynamic_update_slice(v_pool, vp[b].astype(v_pool.dtype), at)
+    return paged._replace(
+        k_pages=k_pool, v_pages=v_pool, lengths=lengths.astype(jnp.int32)
+    )
+
+
+def write_token_kv(
+    k_pages: jnp.ndarray,  # [P, K, ps, D] one layer's pool
+    v_pages: jnp.ndarray,
+    k_new: jnp.ndarray,  # [B, K, D] this step's keys
+    v_new: jnp.ndarray,
+    block_table: jnp.ndarray,  # [B, max_pages]
+    lengths: jnp.ndarray,  # [B] position being written
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Scatter one decode token's K/V into each sequence's current page."""
+    ps = k_pages.shape[2]
+    B = k_new.shape[0]
+    page_slot = lengths // ps
+    offset = lengths % ps
+    for b in range(B):  # B is static and small (decode batch)
+        page = block_table[b, page_slot[b]]
+        k_upd = k_new[b][None, :, None, :].astype(k_pages.dtype)  # [1, K, 1, D]
+        v_upd = v_new[b][None, :, None, :].astype(v_pages.dtype)
+        k_pages = jax.lax.dynamic_update_slice(k_pages, k_upd, (page, 0, offset[b], 0))
+        v_pages = jax.lax.dynamic_update_slice(v_pages, v_upd, (page, 0, offset[b], 0))
+    return k_pages, v_pages
+
+
+def paged_attention_reference(
+    q: jnp.ndarray,  # [B, H, D]
+    k_pages: jnp.ndarray,  # [P, K, ps, D]
+    v_pages: jnp.ndarray,
+    block_table: jnp.ndarray,  # [B, max_pages]
+    lengths: jnp.ndarray,  # [B]
+) -> jnp.ndarray:
+    """Gather-based XLA oracle for the Pallas paged kernel (tests)."""
+    B, H, D = q.shape
+    P, K, ps, _ = k_pages.shape
+    max_pages = block_table.shape[1]
+    S = max_pages * ps
+    # gather each sequence's pages into a contiguous [B, S, K, D] view
+    kg = k_pages[block_table]  # [B, max_pages, K, ps, D]
+    vg = v_pages[block_table]
+    kc = jnp.moveaxis(kg, 2, 3).reshape(B, S, K, D)
+    vc = jnp.moveaxis(vg, 2, 3).reshape(B, S, K, D)
+    positions = (lengths - 1)[:, None]
+    return attention(q[:, None], kc, vc, positions, lengths)[:, 0]

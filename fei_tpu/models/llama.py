@@ -161,6 +161,72 @@ def forward(
     return logits, new_cache
 
 
+def forward_paged(
+    params: dict,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,  # [B, 1] int32 — one decode token per sequence
+    cache,  # PagedKVCache (engine/paged_cache.py)
+) -> tuple[jnp.ndarray, object]:
+    """Single-token decode against a paged KV cache.
+
+    Same math as ``forward`` with T=1, but K/V land in per-sequence pages
+    (write_token_kv) and attention reads through the block table with the
+    Pallas ragged paged kernel. Returns (logits [B, 1, V], updated cache
+    with lengths += 1).
+    """
+    from fei_tpu.engine.paged_cache import write_token_kv
+    from fei_tpu.ops.pallas import paged_attention
+
+    B = tokens.shape[0]
+    K, d, Hq = cfg.num_kv_heads, cfg.head_dim_, cfg.num_heads
+    positions = cache.lengths[:, None]  # [B, 1]
+    max_pos = cache.block_table.shape[1] * cache.page_size
+    cos, sin = compute_rope_freqs(cfg.head_dim_, max_pos, cfg.rope_theta)
+
+    dtype = cache.k_pages.dtype
+    x = params["embed"][tokens].astype(dtype)  # [B, 1, h]
+
+    def body(x, layer_inputs):
+        lp, kp, vp = layer_inputs  # kp/vp: [P, K, ps, D] this layer's pool
+        y = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+        q = (y @ lp["wq"]).reshape(B, 1, Hq, d)
+        k = (y @ lp["wk"]).reshape(B, 1, K, d)
+        v = (y @ lp["wv"]).reshape(B, 1, K, d)
+        q = apply_rope(q, cos, sin, positions)
+        k = apply_rope(k, cos, sin, positions)
+
+        kp, vp = write_token_kv(
+            kp, vp, k[:, 0], v[:, 0], cache.block_table, cache.lengths
+        )
+        attn = paged_attention(
+            q[:, 0], kp, vp, cache.block_table, cache.lengths + 1
+        )  # [B, Hq, D]
+        x = x + attn.reshape(B, 1, Hq * d) @ lp["wo"]
+
+        y = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+        if cfg.is_moe:
+            mlp_out = moe_mlp(
+                y, lp["router"], lp["w_gate"], lp["w_up"], lp["w_down"],
+                cfg.num_experts_per_tok,
+            )
+        else:
+            act = jax.nn.silu((y @ lp["w_gate"]).astype(jnp.float32)).astype(y.dtype)
+            mlp_out = (act * (y @ lp["w_up"])) @ lp["w_down"]
+        return x + mlp_out, (kp, vp)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        body, x, (params["layers"], cache.k_pages, cache.v_pages)
+    )
+
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = (x @ head.astype(x.dtype)).astype(jnp.float32)
+    new_cache = cache._replace(
+        k_pages=new_k, v_pages=new_v, lengths=cache.lengths + 1
+    )
+    return logits, new_cache
+
+
 def forward_train(
     params: dict,
     cfg: ModelConfig,

@@ -1,0 +1,18 @@
+"""Pallas TPU kernels — the performance core (SURVEY.md §7 step 4).
+
+The reference has no kernels at all (its FLOPs leave the process over HTTP,
+fei/core/assistant.py:524-530); these are the greenfield TPU-native hot ops:
+
+- flash_attention: blockwise causal attention for prefill — O(T) memory,
+  online softmax, MXU-shaped [block_q, block_k] score tiles.
+- paged_attention: ragged paged-KV decode attention over a block table.
+
+Every kernel runs in interpret mode on CPU (the hermetic test mesh) and
+compiled on TPU; the XLA-native fei_tpu.ops.attention is the correctness
+oracle for both.
+"""
+
+from fei_tpu.ops.pallas.flash_attention import flash_attention
+from fei_tpu.ops.pallas.paged_attention import paged_attention
+
+__all__ = ["flash_attention", "paged_attention"]

@@ -1,0 +1,212 @@
+"""Blockwise (flash) causal attention as a Pallas TPU kernel.
+
+Replaces the XLA-native oracle (fei_tpu.ops.attention) for prefill, where the
+naive path materializes [B, T, S] scores in HBM. Here scores live only as
+[block_q, block_k] VMEM tiles; the softmax is computed online (running max /
+running sum), so HBM traffic is O(T·D) instead of O(T·S).
+
+Kernel layout (SURVEY.md §7 step 4; the reference has no kernels to port):
+  inputs are transposed head-major ([B, H, T, D]) so VMEM tiles are
+  (seq, head_dim) — the Mosaic-native (sublane, lane) orientation. grid =
+  (B, H, num_q_blocks, num_k_blocks) with the k axis innermost and
+  sequential ("arbitrary"); running softmax state (m, l, acc) persists in
+  VMEM scratch across k steps and the output tile is written on the last k
+  step. GQA is folded into the k/v index maps (kv_head = h // G).
+
+Per-sequence raggedness (cache length, causal offset) comes in as scalar
+prefetch so masks are built from SMEM scalars, never materialized in HBM.
+
+On CPU test meshes the kernel runs in Pallas interpret mode (automatic), so
+the hermetic 8-device suite exercises the same code path as the TPU.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _fwd_kernel(
+    # scalar prefetch
+    q_start_ref,  # [B] absolute position of each batch's first query token
+    kv_len_ref,  # [B] valid kv prefix length (after cache write)
+    # blocks
+    q_ref,  # [1, 1, block_q, D]
+    k_ref,  # [1, 1, block_k, D]
+    v_ref,  # [1, 1, block_k, D]
+    o_ref,  # [1, 1, block_q, D]
+    # scratch
+    m_ref,  # [block_q, 1] running max
+    l_ref,  # [block_q, 1] running sum
+    acc_ref,  # [block_q, D] running output accumulator
+    *,
+    block_q: int,
+    block_k: int,
+    scale: float,
+):
+    b = pl.program_id(0)
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+    num_k = pl.num_programs(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    q_start = q_start_ref[b]
+    kv_len = kv_len_ref[b]
+
+    # absolute positions of this tile's queries / keys
+    q_pos = q_start + qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    )
+    k_pos = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1
+    )
+
+    # skip tiles entirely above the causal diagonal or past the valid prefix
+    block_live = jnp.logical_and(
+        ki * block_k <= q_start + qi * block_q + block_q - 1,
+        ki * block_k < kv_len,
+    )
+
+    @pl.when(block_live)
+    def _compute():
+        q = q_ref[0, 0]  # [block_q, D]
+        k = k_ref[0, 0]  # [block_k, D]
+        v = v_ref[0, 0]
+
+        s = jax.lax.dot_general(
+            q, k,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [block_q, block_k]
+
+        mask = jnp.logical_and(k_pos <= q_pos, k_pos < kv_len)
+        s = jnp.where(mask, s, NEG_INF)
+
+        m_prev = m_ref[:]  # [block_q, 1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+
+        p = jnp.exp(s - m_new)  # [block_q, block_k]
+        correction = jnp.exp(m_prev - m_new)  # [block_q, 1]
+
+        l_ref[:] = correction * l_ref[:] + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = correction * acc_ref[:] + jax.lax.dot_general(
+            p.astype(v.dtype), v,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[:] = m_new
+
+    @pl.when(ki == num_k - 1)
+    def _finalize():
+        # rows with no live key (padding queries) have l == 0; emit zeros
+        l = l_ref[:]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("scale", "block_q", "block_k", "interpret"),
+)
+def flash_attention(
+    q: jnp.ndarray,  # [B, T, H, D]
+    k: jnp.ndarray,  # [B, S, K, D]
+    v: jnp.ndarray,  # [B, S, K, D]
+    q_start: jnp.ndarray,  # [B] int32: absolute position of first query token
+    kv_length: jnp.ndarray,  # [B] int32: valid kv prefix (after cache write)
+    scale: float | None = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """Causal flash attention against a (possibly longer) KV buffer.
+
+    Same contract as fei_tpu.ops.attention.attention: key position s is
+    visible to the query at absolute position p iff s <= p and s < kv_length.
+    Returns [B, T, H, D] in q.dtype.
+    """
+    B, T, H, D = q.shape
+    S, K = k.shape[1], k.shape[2]
+    groups = H // K
+    if scale is None:
+        scale = D ** -0.5
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    # Mosaic tiling: sublane (second-to-last) dim must be a multiple of 8
+    block_q = max(8, min(block_q, _round_up(T, 8)))
+    block_k = max(8, min(block_k, _round_up(S, 8)))
+
+    # pad T/S up to whole blocks; masks make padded work inert
+    T_pad = pl.cdiv(T, block_q) * block_q
+    S_pad = pl.cdiv(S, block_k) * block_k
+
+    # head-major so VMEM tiles are (seq, head_dim)
+    qt = jnp.transpose(q, (0, 2, 1, 3))  # [B, H, T, D]
+    kt = jnp.transpose(k, (0, 2, 1, 3))  # [B, K, S, D]
+    vt = jnp.transpose(v, (0, 2, 1, 3))
+    if T_pad != T:
+        qt = jnp.pad(qt, ((0, 0), (0, 0), (0, T_pad - T), (0, 0)))
+    if S_pad != S:
+        kt = jnp.pad(kt, ((0, 0), (0, 0), (0, S_pad - S), (0, 0)))
+        vt = jnp.pad(vt, ((0, 0), (0, 0), (0, S_pad - S), (0, 0)))
+
+    grid = (B, H, T_pad // block_q, S_pad // block_k)
+
+    kernel = functools.partial(
+        _fwd_kernel, block_q=block_q, block_k=block_k, scale=scale
+    )
+
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec(
+                    (1, 1, block_q, D),
+                    lambda b, h, qi, ki, *_: (b, h, qi, 0),
+                ),
+                pl.BlockSpec(
+                    (1, 1, block_k, D),
+                    lambda b, h, qi, ki, *_: (b, h // groups, ki, 0),
+                ),
+                pl.BlockSpec(
+                    (1, 1, block_k, D),
+                    lambda b, h, qi, ki, *_: (b, h // groups, ki, 0),
+                ),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, 1, block_q, D),
+                lambda b, h, qi, ki, *_: (b, h, qi, 0),
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((block_q, 1), jnp.float32),
+                pltpu.VMEM((block_q, 1), jnp.float32),
+                pltpu.VMEM((block_q, D), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, H, T_pad, D), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(q_start.astype(jnp.int32), kv_length.astype(jnp.int32), qt, kt, vt)
+
+    return jnp.transpose(out[:, :, :T], (0, 2, 1, 3))

@@ -1,0 +1,167 @@
+"""Ragged paged-attention decode kernel (Pallas TPU).
+
+Decode-time attention where the KV cache is paged: each sequence owns a list
+of fixed-size pages scattered through a shared pool, indirected by a block
+table. This is the kernel that keeps the agent's unbounded task-loop
+conversations (reference behavior: fei/core/task_executor.py:231-252 grows
+context monotonically) from forcing one contiguous max-length buffer per
+sequence — HBM is allocated page-by-page as conversations grow.
+
+Grid = (B, K_heads, max_pages); pages are the innermost sequential axis.
+The block table and per-sequence lengths arrive as scalar prefetch, and the
+page index map reads the table directly — Pallas DMAs exactly the pages each
+sequence owns, in table order, with no host gather. Online softmax carries
+(m, l, acc) across pages in VMEM scratch; dead pages (beyond the sequence's
+length) are predicated off with pl.when.
+
+Page pools are stored head-major ([P, K, page_size, D]) so each DMA'd tile
+is (page_size, head_dim) — the Mosaic-native (sublane, lane) orientation.
+
+Interpret mode on CPU; the gather-based oracle for tests lives in
+fei_tpu.engine.paged_cache.paged_attention_reference.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _decode_kernel(
+    # scalar prefetch
+    block_table_ref,  # [B, max_pages] page index per (seq, slot)
+    length_ref,  # [B] valid kv length per sequence
+    # blocks
+    q_ref,  # [1, 1, G, D] this kv head's query group
+    k_ref,  # [1, 1, page_size, D] one page of keys
+    v_ref,  # [1, 1, page_size, D]
+    o_ref,  # [1, 1, G, D]
+    # scratch
+    m_ref,  # [G, 1]
+    l_ref,  # [G, 1]
+    acc_ref,  # [G, D]
+    *,
+    page_size: int,
+    scale: float,
+):
+    b = pl.program_id(0)
+    pi = pl.program_id(2)
+    num_pages = pl.num_programs(2)
+
+    @pl.when(pi == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    length = length_ref[b]
+
+    @pl.when(pi * page_size < length)
+    def _compute():
+        q = q_ref[0, 0]  # [G, D]
+        k = k_ref[0, 0]  # [page_size, D]
+        v = v_ref[0, 0]
+
+        s = jax.lax.dot_general(
+            q, k,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [G, page_size]
+
+        pos = pi * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1
+        )
+        s = jnp.where(pos < length, s, NEG_INF)
+
+        m_prev = m_ref[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        correction = jnp.exp(m_prev - m_new)
+
+        l_ref[:] = correction * l_ref[:] + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = correction * acc_ref[:] + jax.lax.dot_general(
+            p.astype(v.dtype), v,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[:] = m_new
+
+    @pl.when(pi == num_pages - 1)
+    def _finalize():
+        l = l_ref[:]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("scale", "interpret")
+)
+def paged_attention(
+    q: jnp.ndarray,  # [B, H, D] one decode token per sequence
+    k_pages: jnp.ndarray,  # [P, K, page_size, D] shared page pool (head-major)
+    v_pages: jnp.ndarray,  # [P, K, page_size, D]
+    block_table: jnp.ndarray,  # [B, max_pages] int32
+    lengths: jnp.ndarray,  # [B] int32 valid kv length
+    scale: float | None = None,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """Single-token attention over a paged KV cache. Returns [B, H, D]."""
+    B, H, D = q.shape
+    K, page_size = k_pages.shape[1], k_pages.shape[2]
+    G = H // K
+    max_pages = block_table.shape[1]
+    if scale is None:
+        scale = D ** -0.5
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    # group-major so each q tile is this kv head's (G, D) block
+    qg = q.reshape(B, K, G, D)
+
+    kernel = functools.partial(
+        _decode_kernel, page_size=page_size, scale=scale
+    )
+
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(B, K, max_pages),
+            in_specs=[
+                pl.BlockSpec(
+                    (1, 1, G, D),
+                    lambda b, kh, pi, bt, ln: (b, kh, 0, 0),
+                ),
+                pl.BlockSpec(
+                    (1, 1, page_size, D),
+                    lambda b, kh, pi, bt, ln: (bt[b, pi], kh, 0, 0),
+                ),
+                pl.BlockSpec(
+                    (1, 1, page_size, D),
+                    lambda b, kh, pi, bt, ln: (bt[b, pi], kh, 0, 0),
+                ),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, 1, G, D),
+                lambda b, kh, pi, bt, ln: (b, kh, 0, 0),
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((G, 1), jnp.float32),
+                pltpu.VMEM((G, 1), jnp.float32),
+                pltpu.VMEM((G, D), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, K, G, D), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(block_table.astype(jnp.int32), lengths.astype(jnp.int32), qg, k_pages, v_pages)
+
+    return out.reshape(B, H, D)

@@ -1,4 +1,6 @@
 from fei_tpu.parallel.mesh import make_mesh, parse_mesh_shape, best_mesh_shape
+from fei_tpu.parallel.pipeline import pipeline_forward_train
+from fei_tpu.parallel.ring import ring_attention, ulysses_attention
 from fei_tpu.parallel.sharding import (
     param_shardings,
     cache_shardings,
@@ -14,4 +16,7 @@ __all__ = [
     "cache_shardings",
     "shard_params",
     "shard_engine",
+    "ring_attention",
+    "ulysses_attention",
+    "pipeline_forward_train",
 ]

@@ -8,6 +8,7 @@ axes XLA lowers to ICI collectives. Axis conventions used across the package:
   tp — tensor parallel (attention heads / MLP hidden / vocab)
   ep — expert parallel (MoE expert dimension)
   sp — sequence parallel (ring-attention KV block rotation)
+  pp — pipeline parallel (layer stages, GPipe microbatch schedule)
 
 Any axis of size 1 is legal everywhere, so a single chip is just the
 (1,1,1,1) mesh and the same jitted programs serve laptop CPU tests, one v5e
@@ -20,7 +21,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-AXES = ("dp", "tp", "ep", "sp")
+AXES = ("dp", "tp", "ep", "sp", "pp")
 
 
 def parse_mesh_shape(spec: str) -> dict[str, int]:

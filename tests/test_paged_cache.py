@@ -1,0 +1,158 @@
+"""Paged KV cache: allocator invariants, dense→paged copy, and paged decode
+producing the same greedy tokens as the contiguous-cache path."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fei_tpu.engine import GenerationConfig, InferenceEngine
+from fei_tpu.engine.paged_cache import (
+    PageAllocator,
+    PagedKVCache,
+    build_block_table,
+    paged_attention_reference,
+)
+from fei_tpu.ops.pallas import paged_attention
+from fei_tpu.utils.errors import EngineError
+
+
+class TestPageAllocator:
+    def test_alloc_free_cycle(self):
+        a = PageAllocator(num_pages=9, page_size=16)
+        assert a.free_pages == 8  # page 0 reserved
+        got = a.alloc(0, 3)
+        assert len(got) == 3 and 0 not in got
+        assert a.free_pages == 5
+        a.free(0)
+        assert a.free_pages == 8
+
+    def test_contiguous_alloc(self):
+        a = PageAllocator(num_pages=9, page_size=16)
+        run = a.alloc(0, 4, contiguous=True)
+        assert run == sorted(run)
+        assert all(b - a_ == 1 for a_, b in zip(run, run[1:]))
+
+    def test_exhaustion_raises(self):
+        a = PageAllocator(num_pages=3, page_size=16)
+        a.alloc(0, 2)
+        with pytest.raises(EngineError):
+            a.alloc(1, 1)
+
+    def test_pages_needed(self):
+        a = PageAllocator(num_pages=4, page_size=16)
+        assert a.pages_needed(1) == 1
+        assert a.pages_needed(16) == 1
+        assert a.pages_needed(17) == 2
+
+    def test_block_table_padding(self):
+        t = build_block_table([[3, 1], [2]], max_pages=4)
+        np.testing.assert_array_equal(np.asarray(t), [[3, 1, 0, 0], [2, 0, 0, 0]])
+
+
+class TestPagedKernelVsReference:
+    def test_kernel_matches_gather_oracle(self):
+        B, H, K, D, ps, pps = 2, 4, 2, 32, 8, 3
+        key = jax.random.PRNGKey(0)
+        ks = jax.random.split(key, 3)
+        P = B * pps + 1
+        kp = jax.random.normal(ks[0], (P, K, ps, D)) * 0.3
+        vp = jax.random.normal(ks[1], (P, K, ps, D)) * 0.3
+        q = jax.random.normal(ks[2], (B, H, D)) * 0.3
+        table = build_block_table([[1, 2, 3], [4, 5, 6]], pps)
+        lengths = jnp.array([20, 9], dtype=jnp.int32)
+
+        want = paged_attention_reference(q, kp, vp, table, lengths)
+        got = paged_attention(q, kp, vp, table, lengths)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-3)
+
+
+class TestPagedEngine:
+    @pytest.fixture(scope="class")
+    def engines(self):
+        kw = dict(
+            dtype=jnp.float32, seed=0, tokenizer="byte",
+            max_seq_len=128, num_layers=2,
+        )
+        dense = InferenceEngine.from_config("tiny", **kw)
+        paged = InferenceEngine.from_config("tiny", paged=True, page_size=16, **kw)
+        return dense, paged
+
+    def test_greedy_tokens_match_dense(self, engines):
+        dense, paged = engines
+        prompt = dense.tokenizer.encode("The quick brown fox")
+        gen = GenerationConfig(max_new_tokens=24, temperature=0.0, ignore_eos=True)
+        want = dense.generate(prompt, gen).token_ids
+        got = paged.generate(prompt, gen).token_ids
+        assert want == got
+
+    def test_pool_reused_across_generations(self, engines):
+        _, paged = engines
+        prompt = paged.tokenizer.encode("hello")
+        gen = GenerationConfig(max_new_tokens=8, temperature=0.0, ignore_eos=True)
+        first = paged.generate(prompt, gen).token_ids
+        second = paged.generate(prompt, gen).token_ids
+        assert first == second
+        assert paged._allocator.free_pages == paged._allocator.num_pages - 1
+
+    def test_concurrent_paged_streams_rejected(self, engines):
+        _, paged = engines
+        prompt = paged.tokenizer.encode("hello")
+        gen = GenerationConfig(max_new_tokens=8, temperature=0.0, ignore_eos=True)
+        a = paged.generate_stream(prompt, gen)
+        next(a)
+        b = paged.generate_stream(prompt, gen)
+        with pytest.raises(EngineError):
+            next(b)
+        a.close()  # releases seq 0's pages
+        assert paged._allocator.free_pages == paged._allocator.num_pages - 1
+        # engine is usable again after the close
+        assert len(paged.generate(prompt, gen).token_ids) > 0
+
+    def test_small_pool_exhaustion(self):
+        eng = InferenceEngine.from_config(
+            "tiny", dtype=jnp.float32, tokenizer="byte", max_seq_len=128,
+            num_layers=2, paged=True, page_size=16, num_pages=2,
+        )
+        prompt = eng.tokenizer.encode("a long enough prompt to need pages")
+        gen = GenerationConfig(max_new_tokens=64, temperature=0.0, ignore_eos=True)
+        with pytest.raises(EngineError):
+            eng.generate(prompt, gen)
+        # failed allocation must not leak pages or wedge the engine
+        assert eng._allocator.free_pages == eng._allocator.num_pages - 1
+        assert not eng._paged_busy
+
+    def test_crossing_page_boundary(self, engines):
+        dense, paged = engines
+        # prompt of 7 + 30 new tokens crosses the 16-token page boundary twice
+        prompt = dense.tokenizer.encode("probe")
+        gen = GenerationConfig(max_new_tokens=30, temperature=0.0, ignore_eos=True)
+        want = dense.generate(prompt, gen).token_ids
+        got = paged.generate(prompt, gen).token_ids
+        assert want == got
+
+    def test_generate_fused_paged(self, engines):
+        """generate_fused must honor paged mode (no dense max_seq cache) and
+        match the unfused paged stream token-for-token."""
+        dense, paged = engines
+        prompt = paged.tokenizer.encode("fused probe")
+        gen = GenerationConfig(max_new_tokens=25, temperature=0.0, ignore_eos=True)
+        want = dense.generate(prompt, gen).token_ids
+        got = paged.generate_fused(prompt, gen, chunk=8).token_ids
+        assert want == got
+        assert paged._allocator.free_pages == paged._allocator.num_pages - 1
+
+    def test_prompt_pages_exact_not_bucket(self):
+        """A 17-token prompt with page_size 16 must hold 2 prompt pages plus
+        the decode budget — not the 32-token power-of-two bucket's worth."""
+        eng = InferenceEngine.from_config(
+            "tiny", dtype=jnp.float32, tokenizer="byte", max_seq_len=128,
+            num_layers=2, paged=True, page_size=16,
+        )
+        prompt = list(range(10, 27))  # 17 tokens
+        gen = GenerationConfig(max_new_tokens=8, temperature=0.0, ignore_eos=True)
+        stream = eng.generate_stream(prompt, gen)
+        next(stream)
+        # 17 prompt tokens -> 2 pages; 17+8=25 tokens -> 2 pages total needed
+        assert len(eng._allocator.pages_for(0)) == 2
+        stream.close()

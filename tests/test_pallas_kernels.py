@@ -1,0 +1,149 @@
+"""Pallas kernels vs the XLA-native oracle (fei_tpu.ops.attention).
+
+Runs in interpret mode on the CPU test mesh; the same kernel code compiles
+on TPU. Tolerances are loose-ish because the oracle softmax is fp32 while
+the kernels accumulate blockwise.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fei_tpu.ops.attention import attention
+from fei_tpu.ops.pallas import flash_attention, paged_attention
+
+
+def _rand(key, shape, dtype=jnp.float32):
+    return jax.random.normal(key, shape, dtype=jnp.float32).astype(dtype) * 0.3
+
+
+class TestFlashAttention:
+    @pytest.mark.parametrize("T,S,q_start", [(16, 64, 0), (64, 64, 0), (8, 128, 40)])
+    def test_matches_oracle(self, T, S, q_start):
+        B, H, K, D = 2, 4, 2, 64
+        ks = jax.random.split(jax.random.PRNGKey(0), 3)
+        q = _rand(ks[0], (B, T, H, D))
+        k = _rand(ks[1], (B, S, K, D))
+        v = _rand(ks[2], (B, S, K, D))
+        starts = jnp.array([q_start, q_start], dtype=jnp.int32)
+        kv_len = starts + T
+
+        positions = starts[:, None] + jnp.arange(T)[None, :]
+        want = attention(q, k, v, positions, kv_len)
+        got = flash_attention(q, k, v, starts, kv_len, block_q=32, block_k=32)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-3)
+
+    def test_ragged_batch(self):
+        """Different cache offsets per sequence."""
+        B, T, H, K, D, S = 2, 4, 4, 4, 32, 64
+        ks = jax.random.split(jax.random.PRNGKey(1), 3)
+        q = _rand(ks[0], (B, T, H, D))
+        k = _rand(ks[1], (B, S, K, D))
+        v = _rand(ks[2], (B, S, K, D))
+        starts = jnp.array([5, 23], dtype=jnp.int32)
+        kv_len = starts + T
+
+        positions = starts[:, None] + jnp.arange(T)[None, :]
+        want = attention(q, k, v, positions, kv_len)
+        got = flash_attention(q, k, v, starts, kv_len, block_q=8, block_k=16)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-3)
+
+    def test_unaligned_lengths_padded(self):
+        """T not a multiple of block_q — wrapper pads and slices."""
+        B, T, H, K, D, S = 1, 37, 2, 1, 32, 50
+        ks = jax.random.split(jax.random.PRNGKey(2), 3)
+        q = _rand(ks[0], (B, T, H, D))
+        k = _rand(ks[1], (B, S, K, D))
+        v = _rand(ks[2], (B, S, K, D))
+        starts = jnp.zeros((B,), jnp.int32)
+        kv_len = starts + T
+
+        positions = starts[:, None] + jnp.arange(T)[None, :]
+        want = attention(q, k, v, positions, kv_len)
+        got = flash_attention(q, k, v, starts, kv_len, block_q=16, block_k=16)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-3)
+
+    def test_bf16(self):
+        B, T, H, K, D = 1, 32, 4, 2, 64
+        ks = jax.random.split(jax.random.PRNGKey(3), 3)
+        q = _rand(ks[0], (B, T, H, D), jnp.bfloat16)
+        k = _rand(ks[1], (B, T, K, D), jnp.bfloat16)
+        v = _rand(ks[2], (B, T, K, D), jnp.bfloat16)
+        starts = jnp.zeros((B,), jnp.int32)
+        kv_len = starts + T
+        positions = jnp.arange(T)[None, :]
+
+        want = attention(q, k, v, positions, kv_len)
+        got = flash_attention(q, k, v, starts, kv_len, block_q=16, block_k=16)
+        assert got.dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            np.asarray(got, np.float32), np.asarray(want, np.float32), atol=3e-2
+        )
+
+
+class TestPagedAttention:
+    def _setup(self, key, B, H, K, D, page_size, pages_per_seq, lengths):
+        """Build a paged pool + a contiguous view of the same data."""
+        ks = jax.random.split(key, 3)
+        P = B * pages_per_seq + 1  # pool bigger than needed; page 0 unused
+        k_pages = _rand(ks[0], (P, K, page_size, D))
+        v_pages = _rand(ks[1], (P, K, page_size, D))
+        # block table: pages assigned in shuffled order so the kernel's
+        # table indirection (not pool order) is what's exercised
+        rng = np.random.default_rng(0)
+        perm = rng.permutation(np.arange(1, P))
+        table = perm[: B * pages_per_seq].reshape(B, pages_per_seq)
+        block_table = jnp.asarray(table, dtype=jnp.int32)
+
+        S = page_size * pages_per_seq
+
+        def contig(pages):
+            # [pps, K, ps, D] -> [S, K, D]
+            return jnp.stack(
+                [
+                    jnp.moveaxis(pages[table[b]], 1, 2).reshape(S, K, D)
+                    for b in range(B)
+                ]
+            )
+
+        k_contig = contig(k_pages)
+        v_contig = contig(v_pages)
+        q = _rand(ks[2], (B, H, D))
+        return q, k_pages, v_pages, block_table, k_contig, v_contig
+
+    def test_matches_oracle(self):
+        B, H, K, D, page_size, pps = 2, 4, 2, 64, 16, 4
+        lengths = jnp.array([50, 17], dtype=jnp.int32)
+        q, kp, vp, bt, kc, vc = self._setup(
+            jax.random.PRNGKey(0), B, H, K, D, page_size, pps, lengths
+        )
+
+        # oracle: decode token at position length-1 against contiguous cache
+        positions = (lengths - 1)[:, None]
+        want = attention(q[:, None], kc, vc, positions, lengths)[:, 0]
+        got = paged_attention(q, kp, vp, bt, lengths)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-3)
+
+    def test_single_page(self):
+        B, H, K, D, page_size = 1, 2, 2, 32, 8
+        lengths = jnp.array([3], dtype=jnp.int32)
+        q, kp, vp, bt, kc, vc = self._setup(
+            jax.random.PRNGKey(1), B, H, K, D, page_size, 1, lengths
+        )
+        positions = (lengths - 1)[:, None]
+        want = attention(q[:, None], kc, vc, positions, lengths)[:, 0]
+        got = paged_attention(q, kp, vp, bt, lengths)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-3)
+
+    def test_full_pages(self):
+        """Length exactly fills every page."""
+        B, H, K, D, page_size, pps = 1, 4, 4, 32, 8, 3
+        lengths = jnp.array([24], dtype=jnp.int32)
+        q, kp, vp, bt, kc, vc = self._setup(
+            jax.random.PRNGKey(2), B, H, K, D, page_size, pps, lengths
+        )
+        positions = (lengths - 1)[:, None]
+        want = attention(q[:, None], kc, vc, positions, lengths)[:, 0]
+        got = paged_attention(q, kp, vp, bt, lengths)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-3)
