@@ -1,0 +1,32 @@
+"""Basic agent usage: an Assistant with the code-tool suite
+(reference examples/basic_usage.py).
+
+Runs with the mock provider so no weights or network are needed:
+
+    python examples/basic_usage.py
+
+Swap provider="jax_local" (and optionally model="llama3-8b",
+checkpoint via FEI_TPU_CHECKPOINT_DIR) to decode on the local TPU.
+"""
+
+import asyncio
+
+from fei_tpu.agent import Assistant
+from fei_tpu.tools import ToolRegistry, create_code_tools
+
+
+async def main() -> None:
+    registry = ToolRegistry()
+    create_code_tools(registry)  # glob/grep/view/edit/ls/shell/...
+
+    assistant = Assistant(provider="mock", tool_registry=registry)
+    reply = await assistant.chat("What tools do you have available?")
+    print("assistant:", reply)
+
+    # the conversation is stateful; follow-ups share context
+    reply = await assistant.chat("Thanks!")
+    print("assistant:", reply)
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

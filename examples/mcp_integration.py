@@ -1,0 +1,36 @@
+"""MCP client: configure servers via env/config, list services, call one,
+and expose them as agent tools (reference examples/mcp_brave_search.py).
+
+No real MCP server is required for this demo — it shows configuration and
+the registry passthrough wiring, then calls only if a server is reachable.
+
+    FEI_TPU_MCP_SERVER_ECHO='{"type": "http", "url": "http://localhost:9um"}' \
+        python examples/mcp_integration.py
+"""
+
+from fei_tpu.agent.mcp import MCPManager, register_mcp_tools
+from fei_tpu.tools import ToolRegistry
+
+
+def main() -> None:
+    manager = MCPManager()
+    services = manager.list_services()
+    print("configured services:", services or "(none)")
+
+    registry = ToolRegistry()
+    register_mcp_tools(registry, manager)
+    mcp_tools = [n for n in registry.list_tools() if n.startswith(("mcp_", "brave"))]
+    print("registered tools:", mcp_tools)
+
+    for svc in services:
+        try:
+            info = manager.client.call_service(svc, "ping", {})
+            print(f"{svc}.ping ->", info)
+        except Exception as exc:  # noqa: BLE001 — demo: servers may be down
+            print(f"{svc} unreachable: {exc}")
+
+    manager.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,40 @@
+"""Memdir over HTTP: start the REST server, drive it with the connector
+(reference examples/memdir_http_client.py). The connector auto-starts the
+server as a child process and stops it at exit.
+
+    python examples/memdir_http_client.py
+"""
+
+import os
+import tempfile
+
+from fei_tpu.tools.memdir_connector import MemdirConnector
+
+
+def main() -> None:
+    base = tempfile.mkdtemp(prefix="memdir_demo_")
+    os.environ["MEMDIR_BASE"] = base
+
+    conn = MemdirConnector(
+        server_url="http://127.0.0.1:5987", auto_start=True, base_dir=base
+    )
+    if not conn.check_connection() and not conn.start_server():
+        print("server did not start; try: python -m fei_tpu.memory.memdir.server")
+        return
+    print("server healthy:", conn.server_status())
+
+    created = conn.create_memory(
+        "HTTP round-trip memory", tags="demo,http",
+        headers={"Subject": "created over REST"},
+    )
+    print("created:", created.get("id"))
+
+    hits = conn.search("#demo")
+    print("search #demo:", hits.get("count", len(hits.get("results", []))))
+
+    conn.stop_server()
+    print("server stopped")
+
+
+if __name__ == "__main__":
+    main()

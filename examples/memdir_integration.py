@@ -1,0 +1,37 @@
+"""Agent + Memdir: register the memory tool suite so the assistant can
+save/search/recall memories, against a local store — no server needed for
+the direct-store path (reference examples/fei_memdir_integration.py).
+
+    python examples/memdir_integration.py
+"""
+
+import tempfile
+
+from fei_tpu.memory.memdir.samples import create_samples
+from fei_tpu.memory.memdir.search import parse_search_args, search_memories
+from fei_tpu.memory.memdir.store import MemdirStore
+
+
+def main() -> None:
+    with tempfile.TemporaryDirectory() as base:
+        store = MemdirStore(base)
+        n = create_samples(store)
+        print(f"seeded {n} memories")
+
+        # the memdir query language: #tag, field:value, /regex/, sort:, limit:
+        for query in ("#tpu", "Subject:project sort:date", "urgent"):
+            hits = search_memories(store, parse_search_args(query))
+            print(f"{query!r}: {len(hits)} hit(s)")
+            for m in hits[:2]:
+                print("   ", m.headers.get("Subject"))
+
+        mem = store.save(
+            "Ring attention rotates KV blocks over ICI.",
+            tags=["tpu", "notes"],
+        )
+        print("saved:", mem.id)
+        print("folders:", store.list_folders())
+
+
+if __name__ == "__main__":
+    main()

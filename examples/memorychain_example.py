@@ -1,0 +1,35 @@
+"""Memorychain: PoW ledger + FeiCoin wallet, driven in-process
+(reference examples/fei_memorychain_example.py).
+
+    python examples/memorychain_example.py
+"""
+
+import tempfile
+
+from fei_tpu.memory.memorychain.chain import MemoryChain
+
+
+def main() -> None:
+    with tempfile.TemporaryDirectory() as home:
+        chain = MemoryChain(node_id="demo-node", base_dir=home)
+        print("genesis hash:", chain.head.hash[:16], "…")
+
+        block = chain.add_block(
+            {"headers": {"Subject": "first memory"},
+             "content": "proof-of-work mined"},
+        )
+        print(f"mined block #{block.index} nonce={block.nonce} "
+              f"hash={block.hash[:16]}…")
+
+        # no peers configured: propose commits locally
+        block = chain.propose_memory(
+            {"headers": {"Subject": "proposed memory"}, "content": "quorum of 1"}
+        )
+        print(f"proposed -> block #{block.index}")
+
+        print("chain valid:", chain.validate_chain())
+        print("wallet balance:", chain.wallet.balance("demo-node"))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,39 @@
+"""Two Memorychain nodes reaching consensus over HTTP: node B joins via
+node A as seed, a proposal on A is quorum-voted and replicated to B
+(reference docs/HOW_FEI_NETWORK_WORKS.md flow).
+
+    python examples/memorychain_network.py
+"""
+
+import tempfile
+import time
+
+from fei_tpu.memory.memorychain.node import MemorychainNode
+
+
+def main() -> None:
+    with tempfile.TemporaryDirectory() as home:
+        a = MemorychainNode(node_id="node-a", port=0, base_dir=f"{home}/a")
+        a.start_background()
+        b = MemorychainNode(
+            node_id="node-b", port=0, base_dir=f"{home}/b", seed=a.address
+        )
+        b.start_background()
+        time.sleep(0.2)
+        print("a peers:", a.chain.peers)
+        print("b peers:", b.chain.peers)
+
+        block = a.chain.propose_memory(
+            {"headers": {"Subject": "shared memory"}, "content": "via quorum"}
+        )
+        print("proposal committed as block:",
+              block.index if block else "(rejected)")
+        time.sleep(0.3)
+
+        print("a height:", len(a.chain.blocks), "b height:", len(b.chain.blocks))
+        a.shutdown()
+        b.shutdown()
+
+
+if __name__ == "__main__":
+    main()

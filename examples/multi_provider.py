@@ -1,0 +1,38 @@
+"""Switching providers: the agent loop is backend-agnostic — the in-tree
+jax_local TPU decoder, the mock echo provider, and remote HTTP providers all
+implement the same Provider contract (reference examples/multi_provider.py).
+
+    python examples/multi_provider.py
+"""
+
+import asyncio
+
+from fei_tpu.agent import Assistant
+from fei_tpu.agent.providers import MockProvider, ProviderManager
+
+
+async def main() -> None:
+    # 1. by name (resolved through ProviderManager + config/env)
+    assistant = Assistant(provider="mock")
+    print("mock:", await assistant.chat("hello"))
+
+    # 2. by instance — anything implementing Provider.complete/stream
+    class ShoutProvider(MockProvider):
+        name = "shout"
+
+        def complete(self, messages, system=None, tools=None, max_tokens=4000):
+            resp = super().complete(messages, system, tools, max_tokens)
+            resp.content = (resp.content or "").upper()
+            return resp
+
+    assistant = Assistant(provider=ShoutProvider())
+    print("shout:", await assistant.chat("hello"))
+
+    # 3. jax_local: the TPU decoder (random tiny weights without a
+    #    checkpoint; set FEI_TPU_MODEL/checkpoint config for real ones)
+    mgr = ProviderManager("jax_local", "tiny")
+    print("jax_local provider ready:", mgr.get_provider().name)
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -1,0 +1,31 @@
+"""Paged KV cache decode: HBM allocated page-by-page instead of max_seq_len
+up front, with the Pallas ragged paged-attention kernel reading through the
+block table.
+
+    python examples/paged_decode.py
+"""
+
+import jax.numpy as jnp
+
+from fei_tpu.engine import GenerationConfig, InferenceEngine
+
+
+def main() -> None:
+    engine = InferenceEngine.from_config(
+        "tiny", dtype=jnp.float32, tokenizer="byte",
+        max_seq_len=512,
+        paged=True, page_size=32,
+        num_pages=9,  # HBM budget: 9 x 32 = 288 tokens of KV, shared pool
+    )
+    gen = GenerationConfig(max_new_tokens=40, temperature=0.0, ignore_eos=True)
+    prompt = engine.tokenizer.encode("The paged cache grows as needed. ")
+
+    result = engine.generate(prompt, gen)
+    alloc = engine._allocator
+    print(f"decoded {len(result.token_ids)} tokens")
+    print(f"pool: {alloc.num_pages} pages of {alloc.page_size} tokens; "
+          f"{alloc.free_pages} free after release")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,31 @@
+"""Repository map: ranked, token-budgeted symbol overview of a codebase
+(reference examples/repo_map_example.py).
+
+    python examples/repo_map_example.py [path]
+"""
+
+import sys
+
+from fei_tpu.tools.repomap import (
+    generate_repo_dependencies,
+    generate_repo_map,
+    generate_repo_summary,
+)
+
+
+def main() -> None:
+    path = sys.argv[1] if len(sys.argv) > 1 else "."
+    print("=== repo map (1024-token budget) ===")
+    print(generate_repo_map(path, token_budget=1024))
+
+    summary = generate_repo_summary(path)
+    print("\n=== summary ===")
+    for module, files in list(summary.items())[:5]:
+        print(f"{module}: {len(files)} file(s)")
+
+    deps = generate_repo_dependencies(path)
+    print(f"\n=== dependencies: {len(deps)} file(s) with references ===")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,34 @@
+"""The code-search tool suite: glob, grep, batch glob, find-in-files, and
+smart search, called through the tool registry exactly as the agent calls
+them (reference examples/ask_with_search.py + SEARCH_TOOLS.md).
+
+    python examples/search_tools_example.py
+"""
+
+import json
+
+from fei_tpu.tools import ToolRegistry, create_code_tools
+
+
+def call(registry: ToolRegistry, name: str, **args) -> dict:
+    out = registry.execute_tool(name, args)
+    print(f"--- {name}({json.dumps(args)})")
+    text = json.dumps(out, indent=2, default=str)
+    print(text[:400] + ("…" if len(text) > 400 else ""))
+    return out
+
+
+def main() -> None:
+    registry = ToolRegistry()
+    create_code_tools(registry)
+
+    call(registry, "GlobTool", pattern="fei_tpu/ops/*.py")
+    call(registry, "GrepTool", pattern="flash_attention", include="*.py",
+         path="fei_tpu/ops")
+    call(registry, "BatchGlob", patterns=["*.md", "tests/test_p*.py"])
+    call(registry, "FindInFiles", pattern="ppermute", files=["fei_tpu/parallel/ring.py"])
+    call(registry, "SmartSearch", query="def paged_attention python")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,26 @@
+"""Continuous task execution: iterate the agent until it emits
+[TASK_COMPLETE] (reference fei --task mode, task_executor.py).
+
+    python examples/task_executor_example.py
+"""
+
+import asyncio
+
+from fei_tpu.agent import Assistant, TaskExecutor
+from fei_tpu.tools import ToolRegistry, create_code_tools
+
+
+async def main() -> None:
+    registry = ToolRegistry()
+    create_code_tools(registry)
+    assistant = Assistant(provider="mock", tool_registry=registry)
+
+    executor = TaskExecutor(assistant, max_iterations=3)
+    ctx = await executor.execute_task("List the python files in this repo")
+    print(f"completed={ctx.completed} iterations={ctx.iterations} "
+          f"duration={ctx.duration_s:.1f}s")
+    print("final response:", ctx.final_response[:200])
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -1,0 +1,42 @@
+"""Fine-tune loop with checkpoint/resume: the jitted train step (remat'd
+forward, adamw) plus orbax composite checkpoints.
+
+    python examples/train_finetune.py
+"""
+
+import jax
+import jax.numpy as jnp
+
+from fei_tpu.engine import restore_checkpoint, save_checkpoint
+from fei_tpu.engine.train import TrainConfig, make_optimizer, make_train_step
+from fei_tpu.models.configs import get_model_config
+from fei_tpu.models.llama import init_params
+
+
+def main() -> None:
+    cfg = get_model_config("tiny", num_layers=2)
+    tc = TrainConfig(learning_rate=3e-4, remat=True)
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    opt = make_optimizer(tc)
+    opt_state = opt.init(params)
+    _, train_step = make_train_step(cfg, tc)
+
+    data = jax.random.randint(jax.random.PRNGKey(1), (4, 64), 0, cfg.vocab_size)
+
+    ckpt_dir = "/tmp/fei_tpu_finetune_ckpt"
+    for step in range(6):
+        params, opt_state, loss = train_step(params, opt_state, data)
+        print(f"step {step}: loss={float(loss):.4f}")
+        if step == 2:
+            save_checkpoint(ckpt_dir, step, params, opt_state=opt_state)
+            print("  checkpointed at step 2")
+
+    restored = restore_checkpoint(
+        ckpt_dir, target={"params": params, "opt_state": opt_state}
+    )
+    print("restored step-2 checkpoint;",
+          "resume with train_step(restored['params'], restored['opt_state'], ...)")
+
+
+if __name__ == "__main__":
+    main()

@@ -17,6 +17,7 @@ calls leave the process over HTTP, fei/core/assistant.py:524-530):
 
 from __future__ import annotations
 
+import os
 from typing import NamedTuple
 
 import jax
@@ -87,6 +88,29 @@ def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> dict:
     return params
 
 
+_FLASH_MIN_T = 64  # below this, kernel launch overhead beats the fusion win
+
+
+def _attend(q, k, v, kv_length, positions):
+    """Pick the attention path at trace time.
+
+    FEI_TPU_FLASH=1 forces the Pallas flash kernel (interpret mode off-TPU,
+    for tests), =0 forces the XLA oracle; default "auto" uses flash for
+    TPU prefill-sized T. ``kv_length`` is the pre-write cache length [B];
+    keys are valid below kv_length + T.
+    """
+    T = q.shape[1]
+    mode = os.environ.get("FEI_TPU_FLASH", "auto")
+    use_flash = mode == "1" or (
+        mode == "auto" and T >= _FLASH_MIN_T and jax.default_backend() == "tpu"
+    )
+    if use_flash:
+        from fei_tpu.ops.pallas import flash_attention
+
+        return flash_attention(q, k, v, kv_length, kv_length + T)
+    return attention(q, k, v, positions, kv_length + T)
+
+
 def _layer(cfg: ModelConfig, x, lp, cache_k, cache_v, kv_length, positions, cos, sin):
     """One decoder block. x: [B,T,H]; cache_k/v: [B,S,K,D] (this layer's
     slice) or None for the cache-free training path.
@@ -112,7 +136,7 @@ def _layer(cfg: ModelConfig, x, lp, cache_k, cache_v, kv_length, positions, cos,
         new_k = jax.vmap(write)(cache_k, k, kv_length)
         new_v = jax.vmap(write)(cache_v, v, kv_length)
 
-    attn_out = attention(q, new_k, new_v, positions, kv_length + T)
+    attn_out = _attend(q, new_k, new_v, kv_length, positions)
     x = x + attn_out.reshape(B, T, Hq * d) @ lp["wo"]
 
     y = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)

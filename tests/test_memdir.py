@@ -336,3 +336,21 @@ class TestCLI:
         # view marks seen → promoted to cur
         assert main(["--base", base, "list", "--status", "cur"]) == 0
         assert mid in capsys.readouterr().out
+
+
+class TestSamples:
+    def test_create_samples_populates_folders(self, tmp_path):
+        from fei_tpu.memory.memdir.samples import create_samples
+        from fei_tpu.memory.memdir.search import parse_search_args, search_memories
+        from fei_tpu.memory.memdir.store import MemdirStore
+
+        store = MemdirStore(str(tmp_path / "Memdir"))
+        n = create_samples(store)
+        assert n == 20
+        folders = store.list_folders()
+        for f in ("", ".Projects", ".ToDoLater", ".Archive", ".Trash"):
+            assert f in folders
+        assert len(search_memories(store, parse_search_args("#tpu"))) >= 3
+        # archive folder got its seeded entries
+        archived = search_memories(store, parse_search_args("folder:.Archive"))
+        assert len(archived) >= 2
