@@ -24,16 +24,10 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def main() -> int:
-    import jax
+def _build_and_warm(model, n_tokens):
     import jax.numpy as jnp
 
     from fei_tpu.engine import GenerationConfig, InferenceEngine
-
-    model = os.environ.get("FEI_TPU_BENCH_MODEL", "llama3-1b")
-    n_tokens = int(os.environ.get("FEI_TPU_BENCH_TOKENS", "256"))
-    backend = jax.default_backend()
-    log(f"bench: model={model} backend={backend} devices={jax.devices()}")
 
     t0 = time.time()
     engine = InferenceEngine.from_config(
@@ -57,6 +51,25 @@ def main() -> int:
     warm = engine.generate_fused(prompt, gen, chunk=64)
     log(f"bench: warm-up (compile) {time.time()-t0:.1f}s, "
         f"{len(warm.token_ids)} tokens")
+    return engine, prompt, gen
+
+
+def main() -> int:
+    import jax
+
+    model = os.environ.get("FEI_TPU_BENCH_MODEL", "llama3-1b")
+    n_tokens = int(os.environ.get("FEI_TPU_BENCH_TOKENS", "256"))
+    backend = jax.default_backend()
+    log(f"bench: model={model} backend={backend} devices={jax.devices()}")
+
+    try:
+        engine, prompt, gen = _build_and_warm(model, n_tokens)
+    except Exception as exc:  # noqa: BLE001
+        # the flash/pallas path must never sink the bench: fall back to the
+        # XLA oracle attention and try once more
+        log(f"bench: warm-up failed ({exc!r}); retrying with FEI_TPU_FLASH=0")
+        os.environ["FEI_TPU_FLASH"] = "0"
+        engine, prompt, gen = _build_and_warm(model, n_tokens)
 
     # timed runs
     ttfts, tps = [], []

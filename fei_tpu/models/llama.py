@@ -91,18 +91,21 @@ def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> dict:
 _FLASH_MIN_T = 64  # below this, kernel launch overhead beats the fusion win
 
 
-def _attend(q, k, v, kv_length, positions):
+def _attend(q, k, v, kv_length, positions, allow_flash=True):
     """Pick the attention path at trace time.
 
     FEI_TPU_FLASH=1 forces the Pallas flash kernel (interpret mode off-TPU,
     for tests), =0 forces the XLA oracle; default "auto" uses flash for
     TPU prefill-sized T. ``kv_length`` is the pre-write cache length [B];
-    keys are valid below kv_length + T.
+    keys are valid below kv_length + T. ``allow_flash=False`` is the
+    training path: the kernel has no custom VJP yet, so differentiating
+    through it would fail — training stays on the XLA oracle.
     """
     T = q.shape[1]
     mode = os.environ.get("FEI_TPU_FLASH", "auto")
-    use_flash = mode == "1" or (
-        mode == "auto" and T >= _FLASH_MIN_T and jax.default_backend() == "tpu"
+    use_flash = allow_flash and (
+        mode == "1"
+        or (mode == "auto" and T >= _FLASH_MIN_T and jax.default_backend() == "tpu")
     )
     if use_flash:
         from fei_tpu.ops.pallas import flash_attention
@@ -136,7 +139,9 @@ def _layer(cfg: ModelConfig, x, lp, cache_k, cache_v, kv_length, positions, cos,
         new_k = jax.vmap(write)(cache_k, k, kv_length)
         new_v = jax.vmap(write)(cache_v, v, kv_length)
 
-    attn_out = _attend(q, new_k, new_v, kv_length, positions)
+    attn_out = _attend(
+        q, new_k, new_v, kv_length, positions, allow_flash=cache_k is not None
+    )
     x = x + attn_out.reshape(B, T, Hq * d) @ lp["wo"]
 
     y = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)

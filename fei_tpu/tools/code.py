@@ -164,7 +164,12 @@ class GrepTool:
 
             raw = native_scan.grep_files(files, pattern, max_results)
             if raw is not None:
-                return [GrepMatch(f, ln, text) for f, ln, text in raw]
+                matches = [GrepMatch(f, ln, text) for f, ln, text in raw]
+                # same ordering contract as the Python path
+                matches.sort(
+                    key=lambda m: (-_safe_mtime(m.file), m.file, m.line_number)
+                )
+                return matches[:max_results]
         except Exception:  # noqa: BLE001 — native path is best-effort
             pass
         results: list[GrepMatch] = []

@@ -169,7 +169,7 @@ class FeiChatApp:
         if line == "/help":
             self.add_message("system", self._help_text())
             return
-        if line.startswith("/mem"):
+        if line == "/mem" or line.startswith("/mem "):
             self.add_message("user", line)
             out = self.handle_memory_command(line[len("/mem"):].strip())
             self.add_message("memory", out)

@@ -49,3 +49,30 @@ class TestFlashPath:
         oracle_ids = eng.generate(eng.tokenizer.encode(prompt_text), gen).token_ids
 
         assert flash_ids == oracle_ids
+
+
+class TestTrainingPathStaysDifferentiable:
+    def test_grad_with_flash_forced(self, monkeypatch):
+        """FEI_TPU_FLASH=1 must not route the cache-free training forward
+        through the (VJP-less) Pallas kernel — jax.grad must still work."""
+        monkeypatch.setenv("FEI_TPU_FLASH", "1")
+        import optax
+
+        cfg = get_model_config("tiny", num_layers=1)
+        params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (1, 96), 0, cfg.vocab_size)
+
+        def loss_fn(p):
+            from fei_tpu.models.llama import forward_train
+
+            logits = forward_train(p, cfg, tokens[:, :-1], remat=False)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, tokens[:, 1:]
+            ).mean()
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        assert jnp.isfinite(loss)
+        gnorm = jax.tree.reduce(
+            lambda a, b: a + jnp.sum(jnp.abs(b)), grads, 0.0
+        )
+        assert float(gnorm) > 0

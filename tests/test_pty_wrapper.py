@@ -1,0 +1,55 @@
+"""PTY wrapper: real child under a pseudo-terminal, auto-confirmation of
+interactive prompts, exit-code propagation, timeout kill."""
+
+import sys
+
+import pytest
+
+from fei_tpu.tools.pty_wrapper import PtyWrapper
+
+
+def _script(code: str) -> list[str]:
+    return [sys.executable, "-u", "-c", code]
+
+
+class TestPtyWrapper:
+    def test_passthrough_and_exit_code(self):
+        w = PtyWrapper(_script("print('hello pty'); raise SystemExit(3)"),
+                       echo=False)
+        assert w.run() == 3
+        assert "hello pty" in w.output
+
+    def test_auto_confirms_prompt(self):
+        code = (
+            "ans = input('Proceed? [y/N] ')\n"
+            "print('GOT:' + ans)\n"
+            "raise SystemExit(0 if ans == 'y' else 9)\n"
+        )
+        w = PtyWrapper(_script(code), echo=False)
+        assert w.run() == 0
+        assert "GOT:y" in w.output
+
+    def test_custom_response_rules(self):
+        code = (
+            "ans = input('Pick a fruit: ')\n"
+            "raise SystemExit(0 if ans == 'mango' else 9)\n"
+        )
+        w = PtyWrapper(
+            _script(code), responses={r"Pick a fruit": "mango\n"}, echo=False
+        )
+        assert w.run() == 0
+
+    def test_timeout_kills_child(self):
+        w = PtyWrapper(
+            _script("import time; time.sleep(60)"), echo=False, timeout=1.5
+        )
+        rc = w.run()
+        assert rc != 0
+
+    def test_exec_failure(self):
+        w = PtyWrapper(["definitely-not-a-real-binary-xyz"], echo=False)
+        assert w.run() == 127
+
+    def test_rejects_empty_command(self):
+        with pytest.raises(ValueError):
+            PtyWrapper([])
