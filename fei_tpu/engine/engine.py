@@ -174,6 +174,128 @@ class InferenceEngine:
             self._step_cache[key] = jax.jit(step, donate_argnums=(1,))
         return self._step_cache[key]
 
+    def _grammar_fused_fn(
+        self, gen: GenerationConfig, n_steps: int, paged: bool = False
+    ) -> Callable:
+        """Constrained fused decode: the grammar DFA steps ON DEVICE inside
+        the scan — mask = table[state] >= 0 gated by budget feasibility,
+        state' = table[state, token] — so constrained tool-call decoding
+        pays zero per-token host round-trips (SURVEY.md hard part #3)."""
+        key = ("grammar", paged, gen.temperature, gen.top_k, gen.top_p, n_steps)
+        if key not in self._fused_cache:
+            cfg = self.cfg
+            fwd = forward_paged if paged else forward
+            temperature, top_k, top_p = gen.temperature, gen.top_k, gen.top_p
+
+            def fused(params, cache, token, rng, gstate, remaining, table, min_dist):
+                # gstate: [B] int32 DFA state; remaining: [] int32 budget
+                def body(carry, _):
+                    cache, token, rng, gstate, remaining = carry
+                    logits, cache = fwd(params, cfg, token, cache)
+                    logits = logits[:, -1, :]
+
+                    row = table[gstate]  # [B, V]
+                    legal = row >= 0
+                    tgt = jnp.where(legal, row, 0)
+                    feasible = jnp.logical_and(
+                        legal, min_dist[tgt] <= remaining - 1
+                    )
+                    # if feasibility empties a row (shouldn't, inductively),
+                    # fall back to plain legality rather than all -inf
+                    has_feasible = feasible.any(axis=-1, keepdims=True)
+                    mask = jnp.where(has_feasible, feasible, legal)
+                    logits = jnp.where(mask, logits, -jnp.inf)
+
+                    rng, sub = jax.random.split(rng)
+                    nxt = sample_logits(
+                        logits, sub,
+                        temperature=temperature, top_k=top_k, top_p=top_p,
+                    )
+                    gstate = jnp.take_along_axis(
+                        row, nxt[:, None], axis=1
+                    )[:, 0]
+                    return (
+                        cache, nxt[:, None], rng, gstate, remaining - 1
+                    ), nxt
+
+                (cache, token, rng, gstate, remaining), toks = jax.lax.scan(
+                    body, (cache, token, rng, gstate, remaining), None,
+                    length=n_steps,
+                )
+                return jnp.swapaxes(toks, 0, 1), cache, token, rng, gstate, remaining
+
+            self._fused_cache[key] = jax.jit(fused, donate_argnums=(1,))
+        return self._fused_cache[key]
+
+    def generate_constrained(
+        self,
+        prompt_ids: Sequence[int],
+        grammar,
+        gen: GenerationConfig | None = None,
+        chunk: int = 32,
+    ) -> GenerationResult:
+        """Grammar-constrained generation with the DFA on device.
+
+        ``grammar`` is a TokenGrammar (engine.grammar). Equivalent output to
+        generate(..., logit_mask_fn=grammar.logit_mask_fn(max_tokens=...))
+        but the mask/state logic runs inside the fused scan — one host
+        transfer per chunk instead of per token.
+        """
+        gen = gen or GenerationConfig()
+        stops = self._stops(gen)
+        t0 = time.perf_counter()
+        budget = min(gen.max_new_tokens, self.max_seq_len - len(prompt_ids))
+        table, min_dist = grammar.device_tables(self.cfg.vocab_size)
+
+        # first token: prefill logits masked by the entry row, with the same
+        # budget-feasibility rule the device scan applies
+        row = grammar.table[grammar.entry]
+        legal = row >= 0
+        tgt = np.where(legal, row, 0)
+        feasible = legal & (grammar.min_dist[tgt] <= budget - 1)
+        entry_mask = self._pad_mask(feasible if feasible.any() else legal)
+        if self.paged:
+            tok, cache, rng = self._prefill_sample_paged(
+                prompt_ids, gen, entry_mask, budget
+            )
+            slots_left = budget - 1
+        else:
+            tok, cache, rng = self._prefill_sample(prompt_ids, gen, entry_mask)
+            slots_left = self.max_seq_len - len(prompt_ids) - 1
+        first = int(tok[0])
+        ttft = time.perf_counter() - t0
+        out: list[int] = []
+        try:
+            if budget > 0 and first not in stops:
+                out.append(first)
+                gstate = jnp.asarray([grammar.walk([first])], dtype=jnp.int32)
+                remaining = jnp.asarray(budget - 1, dtype=jnp.int32)
+                token = tok.reshape(1, 1)
+                left = budget - 1
+                while left > 0 and slots_left > 0:
+                    n = chunk if slots_left >= chunk else slots_left
+                    fused = self._grammar_fused_fn(gen, n, paged=self.paged)
+                    toks, cache, token, rng, gstate, remaining = fused(
+                        self.params, cache, token, rng, gstate, remaining,
+                        table, min_dist,
+                    )
+                    host = np.asarray(toks)[0, :].tolist()
+                    slots_left -= n
+                    stopped = False
+                    for t in host[: min(n, left)]:
+                        if t in stops:
+                            stopped = True
+                            break
+                        out.append(t)
+                    if stopped:
+                        break
+                    left -= n
+        finally:
+            if self.paged:
+                self._release_paged(cache)
+        total = time.perf_counter() - t0
+        return self._make_result(out, len(prompt_ids), ttft, total)
+
     def _fused_fn(
         self, gen: GenerationConfig, n_steps: int, paged: bool = False
     ) -> Callable:

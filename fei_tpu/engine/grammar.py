@@ -350,6 +350,22 @@ class TokenGrammar:
             dist = new
         return dist
 
+    def device_tables(self, vocab_size: int | None = None):
+        """Transition + min-distance tables as device arrays for the fully
+        on-device constrained decode scan (engine.generate_constrained):
+        mask = table[state] >= 0, state' = table[state, token] — no host
+        round-trip per token. Columns pad with -1 up to ``vocab_size`` (the
+        model's tile-rounded vocab can exceed the tokenizer's)."""
+        import jax.numpy as jnp
+
+        table = self.table
+        if vocab_size is not None and vocab_size > table.shape[1]:
+            pad = np.full(
+                (table.shape[0], vocab_size - table.shape[1]), -1, dtype=np.int32
+            )
+            table = np.concatenate([table, pad], axis=1)
+        return jnp.asarray(table), jnp.asarray(self.min_dist)
+
     def walk(self, token_ids: list[int]) -> int:
         """State after consuming ``token_ids`` from entry; -1 if rejected."""
         s = self.entry

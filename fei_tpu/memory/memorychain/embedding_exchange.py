@@ -48,19 +48,29 @@ def hash_embed(text: str, dim: int = 256) -> np.ndarray:
 
 
 def exchange_banks(
-    local_bank: jnp.ndarray,  # [N, D] this node's embeddings
+    all_banks: jnp.ndarray,  # [n_nodes, N, D] sharded over the node axis
     mesh: Mesh,
     axis_name: str = "dp",
 ) -> jnp.ndarray:
-    """All-gather every node's bank over the node axis → [n_nodes, N, D].
+    """All-gather every node's bank over ``axis_name``.
 
-    ``local_bank`` is the per-node (device-varying) value of a [n_nodes, N,
-    D] global array sharded over ``axis_name``; the gather rides ICI and
-    every node gets the federation-wide bank.
+    ``all_banks`` stacks the per-node banks; the leading dim shards over
+    the axis (n_nodes must be a multiple of the axis size — each device may
+    host several nodes). Returns [axis_size, n_nodes, N, D] where every
+    device row holds the complete federation-wide bank (the rows are
+    identical); read row 0.
     """
+    n = mesh.shape[axis_name]
+    if all_banks.shape[0] % n:
+        raise ValueError(
+            f"num_nodes {all_banks.shape[0]} must be a multiple of "
+            f"axis {axis_name!r} size {n}"
+        )
 
-    def shard_fn(bank):
-        gathered = jax.lax.all_gather(bank[0], axis_name)  # [n_nodes, N, D]
+    def shard_fn(bank):  # bank: [n_nodes/n, N, D] local shard
+        gathered = jax.lax.all_gather(
+            bank, axis_name, tiled=True
+        )  # [n_nodes, N, D]
         return gathered[None]
 
     fn = jax.shard_map(
@@ -69,9 +79,7 @@ def exchange_banks(
         in_specs=P(axis_name),
         out_specs=P(axis_name),
     )
-    # replicate each node's view back out: output [n_nodes, n_nodes, N, D]
-    # sharded over axis 0 — node i's shard holds the full gathered bank
-    return fn(local_bank)
+    return fn(all_banks)
 
 
 class EmbeddingFederation:
@@ -125,8 +133,8 @@ class EmbeddingFederation:
         real pod each node passes its device-local shard; tests stack
         host-side). Stores the gathered federation-wide bank."""
         out = exchange_banks(jnp.asarray(all_banks), mesh, axis_name)
-        # node i's shard (axis 0, index i) holds the full gathered bank
-        self._global = np.asarray(out[self.node_index])
+        # every device row holds the identical full gathered bank
+        self._global = np.asarray(out[0])
         return self._global
 
     def install_global(self, banks: np.ndarray, ids: list[list[str | None]]):

@@ -71,6 +71,10 @@ def grep_files(
 ) -> list[tuple[str, int, str]] | None:
     if not files or not pattern or not is_fixed_string(pattern):
         return None
+    # the C ABI joins paths with '\n'; a (legal, bizarre) newline in a
+    # filename would silently split into bogus paths — full Python fallback
+    if any("\n" in f for f in files):
+        return None
     lib = _load()
     if lib is None:
         return None

@@ -1,3 +1,6 @@
+from fei_tpu.parallel.distributed import initialize as initialize_distributed
+from fei_tpu.parallel.expert import moe_mlp_ep
+from fei_tpu.parallel.long_prefill import prefill_ring
 from fei_tpu.parallel.mesh import make_mesh, parse_mesh_shape, best_mesh_shape
 from fei_tpu.parallel.pipeline import pipeline_forward_train
 from fei_tpu.parallel.ring import ring_attention, ulysses_attention
@@ -19,4 +22,7 @@ __all__ = [
     "ring_attention",
     "ulysses_attention",
     "pipeline_forward_train",
+    "prefill_ring",
+    "moe_mlp_ep",
+    "initialize_distributed",
 ]

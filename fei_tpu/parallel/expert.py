@@ -69,7 +69,8 @@ def moe_mlp_ep(
     mesh: Mesh,
     axis_name: str = "ep",
 ) -> jnp.ndarray:
-    """Expert-parallel MoE. E must divide the ``axis_name`` mesh axis size.
+    """Expert-parallel MoE. The ``axis_name`` mesh axis size must divide E
+    (each device holds E/n whole experts).
 
     Numerically equivalent to ops.moe.moe_mlp; each device computes E/n
     experts and one psum combines.
@@ -77,7 +78,9 @@ def moe_mlp_ep(
     E = router_w.shape[-1]
     n = mesh.shape[axis_name]
     if E % n:
-        raise ValueError(f"num_experts {E} must divide ep axis {n}")
+        raise ValueError(
+            f"ep axis size {n} must divide num_experts {E} evenly"
+        )
     fn = jax.shard_map(
         functools.partial(
             _moe_shard, k=num_experts_per_tok, axis_name=axis_name

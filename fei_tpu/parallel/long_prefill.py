@@ -1,0 +1,131 @@
+"""Sequence-parallel long-prompt prefill: ring attention end-to-end.
+
+Agent task loops grow context monotonically (reference behavior:
+fei/core/task_executor.py:231-252 — conversations are never trimmed), so
+prefill length is unbounded while per-chip memory is not. This runs the
+FULL model forward with the prompt sharded over the ``sp`` mesh axis:
+
+- each device embeds and projects only its T/n-token chunk;
+- attention is ring attention (parallel/ring.py): K/V chunks rotate via
+  ppermute while online softmax folds each visiting block — per-device
+  attention memory is O((T/n)·D) and the traffic rides the ICI ring;
+- MLP/norms are local to the chunk (sequence dim is elementwise there);
+- the produced K/V stay sequence-sharded until the end, where they gather
+  into a standard dense KVCache so ordinary single-token decode continues
+  from the prefilled state.
+
+Returns the same (last_logits, cache) contract as the engine's dense
+prefill, verified against it on the CPU mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from fei_tpu.models.configs import ModelConfig
+from fei_tpu.models.llama import KVCache
+from fei_tpu.ops.moe import moe_mlp
+from fei_tpu.ops.rmsnorm import rms_norm
+from fei_tpu.ops.rope import apply_rope, compute_rope_freqs
+from fei_tpu.parallel.ring import _ring_attention_shard
+
+
+def _prefill_shard(x, layers, cos, sin, *, cfg: ModelConfig, axis_name: str):
+    """Per-device body: full model over the local sequence chunk.
+
+    x: [B, C, H] local embeddings. Returns (x_out, k_chunks, v_chunks)
+    with k/v stacked per layer: [L, B, C, K, D].
+    """
+    B, C, H = x.shape
+    K, d, Hq = cfg.num_kv_heads, cfg.head_dim_, cfg.num_heads
+    my_idx = jax.lax.axis_index(axis_name)
+    positions = (my_idx * C + jnp.arange(C, dtype=jnp.int32))[None, :]
+    positions = jnp.tile(positions, (B, 1))
+
+    def body(x, lp):
+        y = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+        q = (y @ lp["wq"]).reshape(B, C, Hq, d)
+        k = (y @ lp["wk"]).reshape(B, C, K, d)
+        v = (y @ lp["wv"]).reshape(B, C, K, d)
+        q = apply_rope(q, cos, sin, positions)
+        k = apply_rope(k, cos, sin, positions)
+
+        attn = _ring_attention_shard(
+            q, k, v, axis_name=axis_name, scale=d ** -0.5
+        )
+        x = x + attn.reshape(B, C, Hq * d) @ lp["wo"]
+
+        y = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+        if cfg.is_moe:
+            mlp_out = moe_mlp(
+                y, lp["router"], lp["w_gate"], lp["w_up"], lp["w_down"],
+                cfg.num_experts_per_tok,
+            )
+        else:
+            act = jax.nn.silu((y @ lp["w_gate"]).astype(jnp.float32)).astype(y.dtype)
+            mlp_out = (act * (y @ lp["w_up"])) @ lp["w_down"]
+        return x + mlp_out, (k, v)
+
+    x, (ks, vs) = jax.lax.scan(body, x, layers)
+    return x, ks, vs
+
+
+def prefill_ring(
+    params: dict,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,  # [B, T], T divisible by the sp axis size
+    mesh: Mesh,
+    max_seq_len: int | None = None,
+    axis_name: str = "sp",
+) -> tuple[jnp.ndarray, KVCache]:
+    """Sequence-parallel prefill. Returns (last-token logits [B, V] fp32,
+    dense KVCache with length = T, sized ``max_seq_len`` or T)."""
+    B, T = tokens.shape
+    n = mesh.shape[axis_name]
+    if T % n:
+        raise ValueError(f"prompt length {T} must divide sp axis {n}")
+
+    dtype = params["embed"].dtype
+    cos, sin = compute_rope_freqs(cfg.head_dim_, T, cfg.rope_theta)
+    x = params["embed"][tokens].astype(dtype)  # [B, T, H] (sequence-sharded in)
+
+    fn = jax.shard_map(
+        functools.partial(_prefill_shard, cfg=cfg, axis_name=axis_name),
+        mesh=mesh,
+        in_specs=(P(None, axis_name), P(), P(), P()),
+        out_specs=(
+            P(None, axis_name),  # x: stays sequence-sharded
+            P(None, None, axis_name),  # k: [L, B, T, K, D] sharded on seq
+            P(None, None, axis_name),
+        ),
+    )
+    x, k_all, v_all = fn(x, params["layers"], cos, sin)
+
+    # last-token logits (the full x is only needed for its final position)
+    last = x[:, -1, :]
+    last = rms_norm(last, params["final_norm"], cfg.rms_norm_eps)
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = (last @ head.astype(last.dtype)).astype(jnp.float32)
+
+    S = max_seq_len or T
+    if S < T:
+        raise ValueError(f"max_seq_len {S} < prompt length {T}")
+    k_cache = jnp.zeros(
+        (cfg.num_layers, B, S, cfg.num_kv_heads, cfg.head_dim_), dtype=dtype
+    )
+    v_cache = jnp.zeros_like(k_cache)
+    k_cache = jax.lax.dynamic_update_slice(
+        k_cache, k_all.astype(dtype), (0, 0, 0, 0, 0)
+    )
+    v_cache = jax.lax.dynamic_update_slice(
+        v_cache, v_all.astype(dtype), (0, 0, 0, 0, 0)
+    )
+    cache = KVCache(
+        k=k_cache, v=v_cache,
+        length=jnp.full((B,), T, dtype=jnp.int32),
+    )
+    return logits, cache

@@ -184,6 +184,24 @@ def handle_history_command(args) -> int:
         history.entries.clear()
         history.save()
         print("history cleared")
+    elif args.history_action == "load":
+        # replay an entry into a fresh conversation, then continue
+        # interactively (parity: reference cli.py:479-515)
+        idx = args.index
+        if not 0 <= idx < len(history.entries):
+            print(f"no history entry {idx}", file=sys.stderr)
+            return 1
+        entry = list(history.entries)[idx]
+        try:
+            assistant = build_assistant(args)
+        except Exception as exc:  # noqa: BLE001
+            print(f"error: {exc}", file=sys.stderr)
+            return 2
+        assistant.conversation.add_user_message(entry["prompt"])
+        assistant.conversation.add_assistant_message(entry["response"])
+        print(f"[loaded entry {idx}]\nyou> {entry['prompt']}\n"
+              f"fei> {entry['response']}")
+        return chat_loop(assistant, history)
     return 0
 
 
@@ -227,7 +245,7 @@ def parse_args(argv: list[str] | None = None) -> argparse.Namespace:
     p.add_argument("--log-level", default=None)
     sub = p.add_subparsers(dest="command")
     hist = sub.add_parser("history", help="inspect saved prompt history")
-    hist.add_argument("history_action", choices=["list", "show", "clear"])
+    hist.add_argument("history_action", choices=["list", "show", "clear", "load"])
     hist.add_argument("index", nargs="?", type=int, default=0)
     mcp = sub.add_parser("mcp", help="MCP service operations")
     mcp.add_argument("mcp_action", choices=["list", "call"])

@@ -240,3 +240,43 @@ class TestCLI:
         rc = cli.main(["history", "list"])
         out = capsys.readouterr().out
         assert rc == 0 and "remember me" in out
+
+
+class TestHistoryLoad:
+    def test_load_replays_into_conversation(self, tmp_home, capsys, monkeypatch):
+        import fei_tpu.ui.cli as cli
+
+        monkeypatch.setattr(
+            cli, "HISTORY_FILE",
+            str(tmp_home / "history.json"),
+        )
+        hist = cli.History(str(tmp_home / "history.json"))
+        hist.add("what is a mesh?", "a named device grid")
+
+        args = cli.parse_args(["--provider", "mock", "history", "load", "0"])
+        # avoid entering the interactive loop: stub chat_loop
+        monkeypatch.setattr(cli, "chat_loop", lambda assistant, history: 0)
+        captured = {}
+
+        real_build = cli.build_assistant
+
+        def spy_build(a):
+            assistant = real_build(a)
+            captured["assistant"] = assistant
+            return assistant
+
+        monkeypatch.setattr(cli, "build_assistant", spy_build)
+        rc = cli.handle_history_command(args)
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "what is a mesh?" in out
+        msgs = captured["assistant"].conversation.messages
+        assert msgs[0]["role"] == "user"
+        assert msgs[1]["role"] == "assistant"
+
+    def test_load_bad_index(self, tmp_home, monkeypatch):
+        import fei_tpu.ui.cli as cli
+
+        monkeypatch.setattr(cli, "HISTORY_FILE", str(tmp_home / "h.json"))
+        args = cli.parse_args(["history", "load", "7"])
+        assert cli.handle_history_command(args) == 1

@@ -1,0 +1,19 @@
+"""Multi-host init helper: single-host no-op contract and process info."""
+
+import fei_tpu.parallel.distributed as dist
+
+
+class TestDistributed:
+    def test_noop_without_config(self, monkeypatch):
+        monkeypatch.delenv("FEI_TPU_COORDINATOR", raising=False)
+        monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+        monkeypatch.delenv("FEI_TPU_NUM_PROCESSES", raising=False)
+        assert dist.initialize() is False
+        assert dist.is_initialized() is False
+
+    def test_process_info_single_host(self):
+        info = dist.process_info()
+        assert info["process_index"] == 0
+        assert info["process_count"] == 1
+        assert info["local_devices"] == info["global_devices"] >= 1
+        assert info["distributed"] is False

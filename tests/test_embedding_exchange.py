@@ -95,3 +95,25 @@ class TestFederation:
     def test_rejects_bad_node_index(self):
         with pytest.raises(ValueError):
             EmbeddingFederation(5, 4)
+
+
+class TestMultiNodePerDevice:
+    def test_more_nodes_than_devices(self, node_mesh):
+        """num_nodes = 2x devices: no bank may be dropped."""
+        n = node_mesh.shape["dp"]
+        rng = np.random.default_rng(1)
+        banks = rng.normal(size=(2 * n, 4, 8)).astype(np.float32)
+        out = np.asarray(exchange_banks(banks, node_mesh))
+        assert out.shape == (n, 2 * n, 4, 8)
+        for row in range(n):
+            np.testing.assert_allclose(out[row], banks, atol=1e-6)
+
+    def test_rejects_indivisible_nodes(self, node_mesh):
+        n = node_mesh.shape["dp"]
+        if n == 1:
+            import pytest as _pytest
+
+            _pytest.skip("needs >1 device")
+        banks = np.zeros((n + 1, 4, 8), dtype=np.float32)
+        with pytest.raises(ValueError):
+            exchange_banks(banks, node_mesh)

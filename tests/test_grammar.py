@@ -194,3 +194,77 @@ class TestConstrainedDecode:
         )
         obj = json.loads(result.text)
         assert isinstance(obj["q"], str)
+
+
+class TestOnDeviceConstrained:
+    @pytest.fixture(scope="class")
+    def engine(self):
+        return InferenceEngine.from_config(
+            "tiny", dtype=jnp.float32, seed=0, tokenizer="byte",
+            max_seq_len=256, num_layers=2,
+        )
+
+    @pytest.mark.parametrize("seed", [0, 5])
+    def test_matches_host_masked_stream(self, engine, seed):
+        """The on-device DFA scan must emit exactly the tokens the host
+        per-step mask path emits (same seed, same sampling)."""
+        schema = {
+            "type": "object",
+            "properties": {
+                "query": {"type": "string"},
+                "limit": {"type": "integer"},
+            },
+        }
+        tg = compile_tool_call_grammar(schema, engine.tokenizer)
+        gen = GenerationConfig(max_new_tokens=96, temperature=1.0, seed=seed)
+        want = engine.generate(
+            engine.tokenizer.encode("x"), gen,
+            logit_mask_fn=tg.logit_mask_fn(max_tokens=96),
+        ).token_ids
+        got = engine.generate_constrained(
+            engine.tokenizer.encode("x"), tg, gen, chunk=16
+        ).token_ids
+        assert got == want
+
+    def test_output_always_parses(self, engine):
+        schema = {
+            "type": "object",
+            "properties": {
+                "names": {"type": "array", "items": {"type": "string"}},
+                "deep": {
+                    "type": "object",
+                    "properties": {"flag": {"type": "boolean"}},
+                },
+            },
+        }
+        tg = compile_tool_call_grammar(schema, engine.tokenizer)
+        for seed in (1, 2, 3):
+            gen = GenerationConfig(max_new_tokens=120, temperature=1.2, seed=seed)
+            res = engine.generate_constrained(
+                engine.tokenizer.encode("call:"), tg, gen, chunk=32
+            )
+            obj = json.loads(res.text)
+            assert isinstance(obj["names"], list)
+            assert isinstance(obj["deep"]["flag"], bool)
+
+    def test_paged_constrained_matches_dense(self):
+        """generate_constrained must honor paged mode and produce the same
+        tokens as the dense engine."""
+        schema = {"type": "object", "properties": {"q": {"type": "string"}}}
+        kw = dict(dtype=jnp.float32, seed=0, tokenizer="byte",
+                  max_seq_len=256, num_layers=2)
+        gen = GenerationConfig(max_new_tokens=60, temperature=1.0, seed=4)
+        dense = InferenceEngine.from_config("tiny", **kw)
+        tg = compile_tool_call_grammar(schema, dense.tokenizer)
+        want = dense.generate_constrained(
+            dense.tokenizer.encode("y"), tg, gen, chunk=16
+        ).token_ids
+        paged = InferenceEngine.from_config(
+            "tiny", paged=True, page_size=16, **kw
+        )
+        got = paged.generate_constrained(
+            paged.tokenizer.encode("y"), tg, gen, chunk=16
+        ).token_ids
+        assert got == want
+        assert paged._allocator.free_pages == paged._allocator.num_pages - 1
+        json.loads(paged.tokenizer.decode(got))
