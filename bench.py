@@ -54,13 +54,39 @@ def _build_and_warm(model, n_tokens):
     return engine, prompt, gen
 
 
-def main() -> int:
+def _touch_backend_or_reexec():
+    """First device touch, with retry via re-exec.
+
+    A transiently unavailable axon/TPU backend raises at init and the failure
+    is cached for the process lifetime, so an in-process retry is useless —
+    re-exec ourselves with backoff instead (round-1 BENCH died here, rc=1).
+    """
     import jax
 
+    attempt = int(os.environ.get("FEI_TPU_BENCH_ATTEMPT", "0"))
+    try:
+        backend = jax.default_backend()
+        devices = jax.devices()
+    except Exception as exc:  # noqa: BLE001
+        if attempt >= 4:
+            log(f"bench: backend unavailable after {attempt + 1} attempts: {exc!r}")
+            raise
+        delay = 30 * (2 ** attempt)
+        log(f"bench: backend init failed ({exc!r}); retry {attempt + 1}/4 "
+            f"in {delay}s")
+        time.sleep(delay)
+        os.environ["FEI_TPU_BENCH_ATTEMPT"] = str(attempt + 1)
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os.execv(sys.executable, [sys.executable] + sys.argv)
+    return backend, devices
+
+
+def main() -> int:
     model = os.environ.get("FEI_TPU_BENCH_MODEL", "llama3-1b")
     n_tokens = int(os.environ.get("FEI_TPU_BENCH_TOKENS", "256"))
-    backend = jax.default_backend()
-    log(f"bench: model={model} backend={backend} devices={jax.devices()}")
+    backend, devices = _touch_backend_or_reexec()
+    log(f"bench: model={model} backend={backend} devices={devices}")
 
     try:
         engine, prompt, gen = _build_and_warm(model, n_tokens)
