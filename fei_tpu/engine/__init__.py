@@ -5,6 +5,7 @@ from fei_tpu.engine.grammar import (
     compile_tool_call_grammar,
 )
 from fei_tpu.engine.paged_cache import PagedKVCache, PageAllocator
+from fei_tpu.engine.scheduler import PagedScheduler
 from fei_tpu.engine.checkpoint import (
     save_checkpoint,
     restore_checkpoint,
@@ -22,4 +23,5 @@ __all__ = [
     "compile_tool_call_grammar",
     "PagedKVCache",
     "PageAllocator",
+    "PagedScheduler",
 ]

@@ -21,8 +21,6 @@ fei/core/assistant.py:524-530). TPU-first design:
 
 from __future__ import annotations
 
-import functools
-import threading
 import time
 from dataclasses import dataclass, field
 from typing import Callable, Iterator, Sequence
@@ -34,7 +32,7 @@ import numpy as np
 from fei_tpu.engine.sampling import sample_logits
 from fei_tpu.engine.tokenizer import load_tokenizer
 from fei_tpu.models.configs import ModelConfig, get_model_config
-from fei_tpu.models.llama import KVCache, forward, forward_paged, init_params
+from fei_tpu.models.llama import KVCache, forward, init_params
 from fei_tpu.utils.errors import EngineError
 from fei_tpu.utils.logging import get_logger
 from fei_tpu.utils.metrics import METRICS
@@ -69,6 +67,25 @@ def _next_bucket(n: int, minimum: int = 16) -> int:
     return b
 
 
+def pad_vocab_mask(mask, vocab_size: int, xp=jnp):
+    """Pad a tokenizer-vocab logit mask up to the model's (often larger,
+    tile-rounded) vocab; the padded slots are never legal. A mask WIDER than
+    the model vocab means tokenizer/model mismatch — fail loudly instead of
+    silently dropping legal-token entries. ``xp`` picks numpy (host paths)
+    or jax.numpy (device paths); both share this one policy."""
+    if mask is None:
+        return None
+    mask = xp.asarray(mask)
+    if mask.shape[-1] > vocab_size:
+        raise EngineError(
+            f"logit mask width {mask.shape[-1]} exceeds model vocab "
+            f"{vocab_size}; tokenizer and model vocabularies are inconsistent"
+        )
+    if mask.shape[-1] < vocab_size:
+        mask = xp.pad(mask, (0, vocab_size - mask.shape[-1]))
+    return mask
+
+
 class InferenceEngine:
     def __init__(
         self,
@@ -94,9 +111,13 @@ class InferenceEngine:
         self.num_pages = num_pages  # None: worst case for batch_size seqs
         self._pool = None  # lazy PagedKVCache page pool
         self._allocator = None
-        self._paged_busy = False  # one paged stream at a time (seq slot 0)
-        self._paged_lock = threading.Lock()
-        self._dense_to_pages_fn = None  # lazily jitted pool-donating copy
+        # the scheduler object is created eagerly (it is cheap — no device
+        # work) so concurrent first requests can never race its creation
+        self._scheduler = None
+        if paged:
+            from fei_tpu.engine.scheduler import PagedScheduler
+
+            self._scheduler = PagedScheduler(self)
         self._prefill_cache: dict[tuple, Callable] = {}
         self._step_cache: dict[tuple, Callable] = {}
         self._fused_cache: dict[tuple, Callable] = {}
@@ -152,16 +173,16 @@ class InferenceEngine:
             self._prefill_cache[key] = jax.jit(prefill, donate_argnums=(2,))
         return self._prefill_cache[key]
 
-    def _step_fn(self, gen: GenerationConfig, paged: bool = False) -> Callable:
-        """Compiled single-token decode step (dense or paged cache donated)."""
-        key = (paged, gen.temperature, gen.top_k, gen.top_p)
+    def _step_fn(self, gen: GenerationConfig) -> Callable:
+        """Compiled single-token decode step (dense cache donated; paged
+        decode lives in scheduler.PagedScheduler)."""
+        key = (gen.temperature, gen.top_k, gen.top_p)
         if key not in self._step_cache:
             cfg = self.cfg
-            fwd = forward_paged if paged else forward
             temperature, top_k, top_p = gen.temperature, gen.top_k, gen.top_p
 
             def step(params, cache, token, rng, logit_mask):
-                logits, cache = fwd(params, cfg, token, cache)
+                logits, cache = forward(params, cfg, token, cache)
                 logits = logits[:, -1, :]
                 if logit_mask is not None:
                     logits = jnp.where(logit_mask, logits, -jnp.inf)
@@ -175,16 +196,16 @@ class InferenceEngine:
         return self._step_cache[key]
 
     def _grammar_fused_fn(
-        self, gen: GenerationConfig, n_steps: int, paged: bool = False
+        self, gen: GenerationConfig, n_steps: int
     ) -> Callable:
         """Constrained fused decode: the grammar DFA steps ON DEVICE inside
         the scan — mask = table[state] >= 0 gated by budget feasibility,
         state' = table[state, token] — so constrained tool-call decoding
         pays zero per-token host round-trips (SURVEY.md hard part #3)."""
-        key = ("grammar", paged, gen.temperature, gen.top_k, gen.top_p, n_steps)
+        key = ("grammar", gen.temperature, gen.top_k, gen.top_p, n_steps)
         if key not in self._fused_cache:
             cfg = self.cfg
-            fwd = forward_paged if paged else forward
+            fwd = forward
             temperature, top_k, top_p = gen.temperature, gen.top_k, gen.top_p
 
             def fused(params, cache, token, rng, gstate, remaining, table, min_dist):
@@ -243,8 +264,16 @@ class InferenceEngine:
         """
         gen = gen or GenerationConfig()
         stops = self._stops(gen)
-        t0 = time.perf_counter()
         budget = min(gen.max_new_tokens, self.max_seq_len - len(prompt_ids))
+        if self.paged:
+            # paged + constrained: the scheduler applies the grammar as a
+            # per-step host mask, so constrained tool calls batch with every
+            # other in-flight sequence (same tokens as the device scan —
+            # tests assert parity with the dense path)
+            return self.generate(
+                prompt_ids, gen, logit_mask_fn=grammar.logit_mask_fn(budget)
+            )
+        t0 = time.perf_counter()
         table, min_dist = grammar.device_tables(self.cfg.vocab_size)
 
         # first token: prefill logits masked by the entry row, with the same
@@ -254,50 +283,40 @@ class InferenceEngine:
         tgt = np.where(legal, row, 0)
         feasible = legal & (grammar.min_dist[tgt] <= budget - 1)
         entry_mask = self._pad_mask(feasible if feasible.any() else legal)
-        if self.paged:
-            tok, cache, rng = self._prefill_sample_paged(
-                prompt_ids, gen, entry_mask, budget
-            )
-            slots_left = budget - 1
-        else:
-            tok, cache, rng = self._prefill_sample(prompt_ids, gen, entry_mask)
-            slots_left = self.max_seq_len - len(prompt_ids) - 1
+        tok, cache, rng = self._prefill_sample(prompt_ids, gen, entry_mask)
+        slots_left = self.max_seq_len - len(prompt_ids) - 1
         first = int(tok[0])
         ttft = time.perf_counter() - t0
         out: list[int] = []
-        try:
-            if budget > 0 and first not in stops:
-                out.append(first)
-                gstate = jnp.asarray([grammar.walk([first])], dtype=jnp.int32)
-                remaining = jnp.asarray(budget - 1, dtype=jnp.int32)
-                token = tok.reshape(1, 1)
-                left = budget - 1
-                while left > 0 and slots_left > 0:
-                    n = chunk if slots_left >= chunk else slots_left
-                    fused = self._grammar_fused_fn(gen, n, paged=self.paged)
-                    toks, cache, token, rng, gstate, remaining = fused(
-                        self.params, cache, token, rng, gstate, remaining,
-                        table, min_dist,
-                    )
-                    host = np.asarray(toks)[0, :].tolist()
-                    slots_left -= n
-                    stopped = False
-                    for t in host[: min(n, left)]:
-                        if t in stops:
-                            stopped = True
-                            break
-                        out.append(t)
-                    if stopped:
+        if budget > 0 and first not in stops:
+            out.append(first)
+            gstate = jnp.asarray([grammar.walk([first])], dtype=jnp.int32)
+            remaining = jnp.asarray(budget - 1, dtype=jnp.int32)
+            token = tok.reshape(1, 1)
+            left = budget - 1
+            while left > 0 and slots_left > 0:
+                n = chunk if slots_left >= chunk else slots_left
+                fused = self._grammar_fused_fn(gen, n)
+                toks, cache, token, rng, gstate, remaining = fused(
+                    self.params, cache, token, rng, gstate, remaining,
+                    table, min_dist,
+                )
+                host = np.asarray(toks)[0, :].tolist()
+                slots_left -= n
+                stopped = False
+                for t in host[: min(n, left)]:
+                    if t in stops:
+                        stopped = True
                         break
-                    left -= n
-        finally:
-            if self.paged:
-                self._release_paged(cache)
+                    out.append(t)
+                if stopped:
+                    break
+                left -= n
         total = time.perf_counter() - t0
         return self._make_result(out, len(prompt_ids), ttft, total)
 
     def _fused_fn(
-        self, gen: GenerationConfig, n_steps: int, paged: bool = False
+        self, gen: GenerationConfig, n_steps: int
     ) -> Callable:
         """One dispatch that decodes ``n_steps`` tokens via lax.scan.
 
@@ -305,10 +324,10 @@ class InferenceEngine:
         ms over a tunneled chip); this amortizes it to one per chunk, which
         is what bench-grade throughput and batch generation use. The cache
         (dense or paged pool) is donated through the scan."""
-        key = (paged, gen.temperature, gen.top_k, gen.top_p, n_steps)
+        key = (gen.temperature, gen.top_k, gen.top_p, n_steps)
         if key not in self._fused_cache:
             cfg = self.cfg
-            fwd = forward_paged if paged else forward
+            fwd = forward
             temperature, top_k, top_p = gen.temperature, gen.top_k, gen.top_p
 
             def fused(params, cache, token, rng):  # token: [B, 1]
@@ -366,93 +385,20 @@ class InferenceEngine:
             self._allocator = PageAllocator(num_pages, self.page_size)
         return self._pool
 
-    def _prefill_sample_paged(self, prompt_ids, gen: GenerationConfig, mask, budget):
-        """Paged prologue: dense prefill into a bucket-sized throwaway cache,
-        copy K/V into freshly allocated pages (pool donated through a jitted
-        copy — no host-side duplicate of the pool), sample the first token.
-
-        The busy flag is taken under a lock *before* any device work so two
-        threads can never interleave allocations for seq slot 0; any failure
-        anywhere in the prologue returns the pages and clears the flag."""
-        from fei_tpu.engine.paged_cache import build_block_table, dense_to_pages
-
-        with self._paged_lock:
-            if self._paged_busy:
-                raise EngineError(
-                    "a paged generation stream is already active on this "
-                    "engine; finish or close it before starting another"
-                )
-            self._paged_busy = True
-        allocated = False
-        try:
-            pool = self._ensure_pool()
-            alloc = self._allocator
-            n = len(prompt_ids)
-            bucket = min(_next_bucket(n), self.max_seq_len)
-
-            with METRICS.span("prefill", jax_trace=True):
-                dense = KVCache.create(self.cfg, 1, bucket, dtype=self.dtype)
-                last_logits, dense = self.prefill([list(prompt_ids)], dense)
-                last_logits.block_until_ready()
-
-            # prompt pages contiguous (one dynamic_update_slice per seq),
-            # decode-budget pages free-form; allocate for the true prompt
-            # length, not the power-of-two prefill bucket
-            prompt_pages = alloc.alloc(0, alloc.pages_needed(n), contiguous=True)
-            allocated = True
-            total_pages = alloc.pages_needed(min(n + budget, self.max_seq_len))
-            if total_pages > len(prompt_pages):
-                alloc.alloc(0, total_pages - len(prompt_pages))
-            table = build_block_table(
-                [alloc.pages_for(0)], pool.block_table.shape[1]
+    @property
+    def scheduler(self):
+        """The continuous-batching scheduler; all paged generation —
+        including concurrent streams from multiple threads — goes through
+        it."""
+        if self._scheduler is None:
+            raise EngineError(
+                "this engine was not constructed with paged=True; the "
+                "decode scheduler only exists for paged engines"
             )
-            if self._dense_to_pages_fn is None:
-                self._dense_to_pages_fn = jax.jit(
-                    dense_to_pages, donate_argnums=(0,)
-                )
-            keep = len(prompt_pages) * self.page_size  # bucket tail is garbage
-            pool_in = pool._replace(block_table=table)
-            self._pool = None  # donated below; arrays are invalid afterwards
-            pcache = self._dense_to_pages_fn(
-                pool_in, dense.k[:, :, :keep], dense.v[:, :, :keep],
-                jnp.array([n], dtype=jnp.int32),
-                jnp.array([prompt_pages[0]], dtype=jnp.int32),
-            )
-
-            if mask is not None:
-                last_logits = jnp.where(mask[None, :], last_logits, -jnp.inf)
-            rng = jax.random.PRNGKey(gen.seed)
-            rng, sub = jax.random.split(rng)
-            tok = sample_logits(
-                last_logits, sub,
-                temperature=gen.temperature, top_k=gen.top_k, top_p=gen.top_p,
-            )
-            return tok, pcache, rng
-        except BaseException:
-            if allocated:
-                self._allocator.free(0)
-            self._paged_busy = False
-            raise
-
-    def _release_paged(self, pcache) -> None:
-        """Return sequence 0's pages to the allocator and keep the updated
-        pool arrays for the next generation."""
-        if self._paged_busy and self._allocator is not None:
-            self._allocator.free(0)
-        self._paged_busy = False
-        if pcache is not None:
-            self._pool = pcache
+        return self._scheduler
 
     def _pad_mask(self, mask) -> jnp.ndarray | None:
-        """Pad a tokenizer-vocab mask up to the model's (often larger,
-        tile-rounded) vocab — the padded logit slots are never legal."""
-        if mask is None:
-            return None
-        mask = jnp.asarray(mask)
-        V = self.cfg.vocab_size
-        if mask.shape[-1] < V:
-            mask = jnp.pad(mask, (0, V - mask.shape[-1]))
-        return mask[:V]
+        return pad_vocab_mask(mask, self.cfg.vocab_size, xp=jnp)
 
     def _stops(self, gen: GenerationConfig) -> set[int]:
         if gen.ignore_eos:
@@ -526,39 +472,34 @@ class InferenceEngine:
         for unconstrained steps.
         """
         gen = gen or GenerationConfig()
+        if self.paged:
+            # continuous batching: the scheduler admits this request into a
+            # batch slot; any number of concurrent streams share the pool
+            yield from self.scheduler.stream(prompt_ids, gen, logit_mask_fn)
+            return
         stops = self._stops(gen)
         generated: list[int] = []
         mask = self._pad_mask(logit_mask_fn(generated)) if logit_mask_fn else None
         # never decode past the cache: each step writes one KV slot
         budget = min(gen.max_new_tokens, self.max_seq_len - len(prompt_ids))
         # first token comes from the prefill logits
-        if self.paged:
-            tok, cache, rng = self._prefill_sample_paged(
-                prompt_ids, gen, mask, budget
-            )
-            step = self._step_fn(gen, paged=True)
-        else:
-            tok, cache, rng = self._prefill_sample(prompt_ids, gen, mask)
-            step = self._step_fn(gen)
-        try:
-            tok_host = int(tok[0])
-            for i in range(budget):
-                if tok_host in stops:
-                    break
-                generated.append(tok_host)
-                yield tok_host
-                if i == budget - 1:
-                    break  # cache full: don't run a step whose KV slot doesn't exist
-                mask = self._pad_mask(logit_mask_fn(generated)) if logit_mask_fn else None
-                mask_dev = None if mask is None else mask[None, :]
-                with METRICS.span("decode_step"):
-                    tok, cache, rng = step(
-                        self.params, cache, tok.reshape(1, 1), rng, mask_dev
-                    )
-                    tok_host = int(tok[0])  # host sync inside the span
-        finally:
-            if self.paged:
-                self._release_paged(cache)
+        tok, cache, rng = self._prefill_sample(prompt_ids, gen, mask)
+        step = self._step_fn(gen)
+        tok_host = int(tok[0])
+        for i in range(budget):
+            if tok_host in stops:
+                break
+            generated.append(tok_host)
+            yield tok_host
+            if i == budget - 1:
+                break  # cache full: don't run a step whose KV slot doesn't exist
+            mask = self._pad_mask(logit_mask_fn(generated)) if logit_mask_fn else None
+            mask_dev = None if mask is None else mask[None, :]
+            with METRICS.span("decode_step"):
+                tok, cache, rng = step(
+                    self.params, cache, tok.reshape(1, 1), rng, mask_dev
+                )
+                tok_host = int(tok[0])  # host sync inside the span
 
     def generate(
         self, prompt_ids: Sequence[int], gen: GenerationConfig | None = None, **kw
@@ -584,52 +525,44 @@ class InferenceEngine:
         ``chunk`` decoded tokens. Stop tokens are honored at chunk
         granularity (host truncates at the first stop)."""
         gen = gen or GenerationConfig()
+        if self.paged:
+            # paged mode decodes through the continuous-batching scheduler
+            # (per-step batching across all in-flight sequences); the chunk
+            # knob only applies to the dense single-stream scan
+            return self.generate(prompt_ids, gen)
         stops = self._stops(gen)
         t0 = time.perf_counter()
         budget = min(gen.max_new_tokens, self.max_seq_len - len(prompt_ids))
-        if self.paged:
-            tok, cache, rng = self._prefill_sample_paged(
-                prompt_ids, gen, None, budget
-            )
-            fused_factory = functools.partial(self._fused_fn, paged=True)
-            # paged pool only has pages for the generation budget
-            slots_left = budget - 1
-        else:
-            tok, cache, rng = self._prefill_sample(prompt_ids, gen)
-            fused_factory = self._fused_fn
-            # KV slots available for scan writes (each step writes one)
-            slots_left = self.max_seq_len - len(prompt_ids) - 1
+        tok, cache, rng = self._prefill_sample(prompt_ids, gen)
+        # KV slots available for scan writes (each step writes one)
+        slots_left = self.max_seq_len - len(prompt_ids) - 1
         first = int(tok[0])
         ttft = time.perf_counter() - t0
         out: list[int] = []
-        try:
-            if budget > 0 and first not in stops:
-                out.append(first)
-                token = tok.reshape(1, 1)
-                remaining = budget - 1
-                while remaining > 0 and slots_left > 0:
-                    # always scan a full chunk when the cache has room and
-                    # truncate on the host — one compiled program per sampling
-                    # config instead of one per tail length
-                    n = chunk if slots_left >= chunk else slots_left
-                    fused = fused_factory(gen, n)
-                    toks, cache, token, rng = fused(self.params, cache, token, rng)
-                    # ONE host transfer per chunk; indexing the device array per
-                    # element would pay a device round-trip per token
-                    host = np.asarray(toks)[0, :].tolist()
-                    slots_left -= n
-                    stopped = False
-                    for t in host[: min(n, remaining)]:
-                        if t in stops:
-                            stopped = True
-                            break
-                        out.append(t)
-                    if stopped:
+        if budget > 0 and first not in stops:
+            out.append(first)
+            token = tok.reshape(1, 1)
+            remaining = budget - 1
+            while remaining > 0 and slots_left > 0:
+                # always scan a full chunk when the cache has room and
+                # truncate on the host — one compiled program per sampling
+                # config instead of one per tail length
+                n = chunk if slots_left >= chunk else slots_left
+                fused = self._fused_fn(gen, n)
+                toks, cache, token, rng = fused(self.params, cache, token, rng)
+                # ONE host transfer per chunk; indexing the device array per
+                # element would pay a device round-trip per token
+                host = np.asarray(toks)[0, :].tolist()
+                slots_left -= n
+                stopped = False
+                for t in host[: min(n, remaining)]:
+                    if t in stops:
+                        stopped = True
                         break
-                    remaining -= n
-        finally:
-            if self.paged:
-                self._release_paged(cache)
+                    out.append(t)
+                if stopped:
+                    break
+                remaining -= n
         total = time.perf_counter() - t0
         return self._make_result(out, len(prompt_ids), ttft, total)
 

@@ -130,18 +130,84 @@ class JsonSchemaGrammar:
         return entry
 
     def _object(self, schema: dict, nxt: int) -> int:
+        """Object with properties in schema order; properties listed in the
+        schema's ``required`` are mandatory, the rest may be skipped (the
+        reference's registry validates only ``required``,
+        fei/tools/registry.py:92-153). A schema with NO ``required`` key
+        keeps the all-properties-mandatory behavior — for *generation* that
+        is the deterministic safe reading of an unannotated schema."""
         props: dict = schema.get("properties", {})
         if not props:
             return self.dfa.lit(b"{}", nxt)
-        close = self.dfa.lit(b"}", nxt)
-        cur = close
         items = list(props.items())
-        for i, (key, sub) in enumerate(reversed(items)):
-            first = i == len(items) - 1
-            prefix = b'{"' if first else b',"'
-            value_entry = self._value(sub, cur)
-            cur = self.dfa.lit(prefix + key.encode("utf-8") + b'":', value_entry)
-        return cur
+        n = len(items)
+        required = set(schema.get("required", [k for k, _ in items]))
+        unknown = required - {k for k, _ in items}
+        if unknown:
+            raise EngineError(
+                f"schema lists required properties not in 'properties': "
+                f"{sorted(unknown)}"
+            )
+        # opt_suffix[i]: every property from i on is optional, so '}' is
+        # legal from the separator state with candidates i..n-1
+        opt_suffix = [False] * (n + 1)
+        opt_suffix[n] = True
+        for i in range(n - 1, -1, -1):
+            opt_suffix[i] = opt_suffix[i + 1] and items[i][0] not in required
+
+        def choices(start: int) -> list[tuple[bytes, int]]:
+            """Emittable next properties from position ``start``: each
+            optional property may be skipped, a required one may not."""
+            opts = []
+            j = start
+            while j < n:
+                key, _ = items[j]
+                opts.append((b'"' + key.encode("utf-8") + b'":', value_entry[j]))
+                if key in required:
+                    break
+                j += 1
+            return opts
+
+        # built back-to-front: sep[i] = "a value just closed; properties
+        # i..n-1 remain candidates" (',' continues, '}' closes if allowed)
+        sep: list[int | None] = [None] * (n + 1)
+        s = self.dfa.new_state()
+        self.dfa.trans[s][0x7D] = nxt  # '}'
+        sep[n] = s
+        value_entry: list[int | None] = [None] * n
+        for i in range(n - 1, -1, -1):
+            value_entry[i] = self._value(items[i][1], sep[i + 1])
+            s = self.dfa.new_state()
+            self.dfa.trans[s][0x2C] = self._branch(choices(i))  # ','
+            if opt_suffix[i]:
+                self.dfa.trans[s][0x7D] = nxt
+            sep[i] = s
+
+        first = self._branch(choices(0))
+        if opt_suffix[0]:
+            self.dfa.trans[first][0x7D] = nxt  # '{}' legal
+        entry = self.dfa.new_state()
+        entry_trans = self.dfa.trans[entry]
+        entry_trans[0x7B] = first  # '{'
+        return entry
+
+    def _branch(self, options: list[tuple[bytes, int]]) -> int:
+        """Trie over distinct byte strings sharing one entry state, each
+        path ending at its target — the choice point for which property to
+        emit next (keys can share prefixes)."""
+        groups: dict[int, list[tuple[bytes, int]]] = {}
+        for bs, tgt in options:
+            if not bs:
+                raise EngineError("ambiguous property-name trie (empty branch)")
+            groups.setdefault(bs[0], []).append((bs[1:], tgt))
+        entry = self.dfa.new_state()
+        for b, subs in groups.items():
+            if len(subs) == 1:
+                rest, tgt = subs[0]
+                self.dfa.trans[entry][b] = self.dfa.lit(rest, tgt)
+            else:
+                self.dfa.trans[entry][b] = self._branch(subs)
+        return entry
 
     def _string(self, schema: dict, nxt: int) -> int:
         body = self.dfa.new_state()

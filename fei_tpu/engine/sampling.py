@@ -35,3 +35,44 @@ def sample_logits(
         cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx[:, None], axis=-1)
         logits = jnp.where(logits < cutoff, -jnp.inf, logits)
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+
+def _sample_row_dynamic(
+    logits: jnp.ndarray,  # [V]
+    key: jax.Array,
+    temperature: jnp.ndarray,  # [] float32
+    top_k: jnp.ndarray,  # [] int32 (0 = off)
+    top_p: jnp.ndarray,  # [] float32 (1.0 = off)
+) -> jnp.ndarray:
+    """One sequence's sample with *traced* sampling knobs.
+
+    Mirrors ``sample_logits`` exactly (same filters, same key usage) but all
+    branches are data-dependent ``where``s, so one compiled program serves
+    every per-sequence config in a continuous batch."""
+    V = logits.shape[-1]
+    greedy = jnp.argmax(logits).astype(jnp.int32)
+    scaled = logits / jnp.maximum(temperature, 1e-8)
+    sorted_desc = jnp.sort(scaled)[::-1]
+    apply_k = (top_k > 0) & (top_k < V)
+    kth = sorted_desc[jnp.clip(top_k - 1, 0, V - 1)]
+    scaled = jnp.where(apply_k & (scaled < kth), -jnp.inf, scaled)
+    sorted_f = jnp.where(apply_k & (sorted_desc < kth), -jnp.inf, sorted_desc)
+    probs = jax.nn.softmax(sorted_f)
+    cutoff_idx = jnp.sum(jnp.cumsum(probs) < top_p)
+    cutoff = sorted_f[jnp.clip(cutoff_idx, 0, V - 1)]
+    apply_p = top_p < 1.0
+    scaled = jnp.where(apply_p & (scaled < cutoff), -jnp.inf, scaled)
+    sampled = jax.random.categorical(key, scaled).astype(jnp.int32)
+    return jnp.where(temperature > 0.0, sampled, greedy)
+
+
+def sample_logits_dynamic(
+    logits: jnp.ndarray,  # [B, V]
+    keys: jax.Array,  # [B] per-sequence PRNG keys
+    temperatures: jnp.ndarray,  # [B]
+    top_ks: jnp.ndarray,  # [B] int32
+    top_ps: jnp.ndarray,  # [B]
+) -> jnp.ndarray:
+    """Per-sequence sampling for the continuous-batching scheduler: each row
+    has its own key/temperature/top-k/top-p. Returns token ids [B]."""
+    return jax.vmap(_sample_row_dynamic)(logits, keys, temperatures, top_ks, top_ps)

@@ -1,0 +1,457 @@
+"""Continuous-batching decode scheduler over the shared paged KV pool.
+
+The reference's agent loop grows conversations unboundedly and runs many of
+them at once (fei/core/task_executor.py:231-252 — each task iteration is a
+fresh completion over an ever-longer context). Serving that on one chip
+means many sequences of very different lengths sharing HBM — exactly what
+the paged pool (engine/paged_cache.py) provides. This module adds the
+missing piece: a scheduler that admits N concurrent sequences into batch
+slots, decodes them in ONE batched paged forward per step, and evicts /
+admits at sequence boundaries (continuous batching, vLLM-style, realized
+TPU-first: a single compiled step program with static [B] shapes, per-slot
+sampling knobs as traced arrays, pool donated through every dispatch).
+
+Design notes
+- One daemon thread owns the device loop; ``submit()`` only enqueues. All
+  pool mutation happens on that thread, so there are no cross-thread device
+  races by construction.
+- Admission = dense bucketed prefill (one [1, bucket] forward) + per-page
+  scatter of the prompt K/V into freshly allocated pages + block-table row
+  update, all in one jitted program with the pool donated.
+- Each sequence keeps the SAME per-sequence PRNG chain as the single-stream
+  dense path (PRNGKey(seed) → split at prefill → split per step), so a
+  request decoded through the scheduler yields token-for-token what the
+  dense engine yields for the same seed — concurrency never changes output.
+- Inactive slots still flow through the batched forward (static shapes);
+  their block-table rows are zeroed at eviction so their KV writes land in
+  the reserved null page 0 and can never corrupt a live sequence's pages.
+- Per-slot sampling (temperature/top-k/top-p) uses sample_logits_dynamic —
+  traced knobs, one compiled program for every config mix.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable, Iterator, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fei_tpu.engine.sampling import sample_logits, sample_logits_dynamic
+from fei_tpu.models.llama import KVCache, forward_paged
+from fei_tpu.utils.errors import EngineError
+from fei_tpu.utils.logging import get_logger
+from fei_tpu.utils.metrics import METRICS
+
+log = get_logger("scheduler")
+
+_DONE = object()
+
+
+@dataclass
+class _Seq:
+    """One in-flight generation request."""
+
+    prompt_ids: list[int]
+    gen: object  # GenerationConfig
+    mask_fn: Callable[[list[int]], np.ndarray | None] | None
+    stops: set[int]
+    out: queue.Queue = field(default_factory=queue.Queue)
+    generated: list[int] = field(default_factory=list)
+    budget: int = 0
+    slot: int = -1
+    next_input: int = 0
+    cancelled: bool = False
+    finished: bool = False
+
+
+class PagedScheduler:
+    """Multi-sequence decode over one paged pool (one per paged engine).
+
+    ``engine.batch_size`` bounds concurrent sequences; further requests
+    queue FIFO and admit as slots free up. A request whose page demand can
+    never fit the pool fails immediately with EngineError.
+    """
+
+    def __init__(self, engine):
+        self.engine = engine
+        self.B = engine.batch_size
+        self._slots: list[_Seq | None] = [None] * self.B
+        self._waiting: deque[_Seq] = deque()
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._pool = None  # owned page pool (donated through every dispatch)
+        self._keys = None  # [B, 2] per-slot PRNG keys
+        self._step_jit: dict = {}
+        self._admit_jit: dict = {}
+        self._evict_jit = None
+
+    # -- public API ---------------------------------------------------------
+
+    def stream(
+        self,
+        prompt_ids: Sequence[int],
+        gen,
+        logit_mask_fn: Callable[[list[int]], np.ndarray | None] | None = None,
+    ) -> Iterator[int]:
+        """Submit a request and yield its tokens as they decode.
+
+        Closing the iterator (or abandoning it to GC) cancels the request
+        and returns its pages/slot to the pool — an abandoned stream can
+        never wedge the engine (round-1 advisory)."""
+        seq = self.submit(prompt_ids, gen, logit_mask_fn)
+        try:
+            while True:
+                item = seq.out.get()
+                if item is _DONE:
+                    break
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        finally:
+            self.cancel(seq)
+
+    def submit(self, prompt_ids, gen, logit_mask_fn=None) -> _Seq:
+        eng = self.engine
+        n = len(prompt_ids)
+        if n > eng.max_seq_len:
+            raise EngineError(
+                f"prompt length {n} exceeds engine max_seq_len {eng.max_seq_len}"
+            )
+        self._ensure_pool()
+        alloc = eng._allocator
+        budget = min(gen.max_new_tokens, eng.max_seq_len - n)
+        need = alloc.pages_needed(min(n + budget, eng.max_seq_len))
+        if need > alloc.num_pages - 1:
+            raise EngineError(
+                f"request needs {need} pages but the pool holds "
+                f"{alloc.num_pages - 1}; raise num_pages or shrink "
+                "max_new_tokens"
+            )
+        seq = _Seq(
+            prompt_ids=list(prompt_ids),
+            gen=gen,
+            mask_fn=logit_mask_fn,
+            stops=eng._stops(gen),
+            budget=budget,
+        )
+        with self._lock:
+            self._waiting.append(seq)
+            self._start_thread()
+        self._wake.set()
+        return seq
+
+    def cancel(self, seq: _Seq) -> None:
+        with self._lock:
+            if seq in self._waiting:
+                self._waiting.remove(seq)
+                seq.finished = True
+                return
+            seq.cancelled = True
+        self._wake.set()
+
+    # -- scheduler thread ---------------------------------------------------
+
+    def _start_thread(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._loop, name="fei-paged-scheduler", daemon=True
+            )
+            self._thread.start()
+
+    def _loop(self) -> None:
+        while True:
+            try:
+                self._reap_cancelled()
+                self._admit_ready()
+                if not any(self._slots):
+                    self._wake.wait(timeout=0.1)
+                    self._wake.clear()
+                    continue
+                self._step_active()
+            except BaseException as exc:  # noqa: BLE001
+                log.error("scheduler loop error: %r", exc)
+                self._fail_all(exc)
+
+    def _reap_cancelled(self) -> None:
+        for b, s in enumerate(self._slots):
+            if s is not None and s.cancelled and not s.finished:
+                self._finish(s)
+
+    def _admit_ready(self) -> None:
+        """FIFO admission: fill free slots while the pool has pages. Head-of-
+        line blocking is deliberate — it guarantees a too-big-for-now request
+        eventually runs instead of starving behind smaller latecomers."""
+        while True:
+            with self._lock:
+                if not self._waiting:
+                    return
+                free = [b for b, s in enumerate(self._slots) if s is None]
+                if not free:
+                    return
+                seq = self._waiting[0]
+                alloc = self.engine._allocator
+                need = alloc.pages_needed(
+                    min(len(seq.prompt_ids) + seq.budget, self.engine.max_seq_len)
+                )
+                if need > alloc.free_pages:
+                    return
+                self._waiting.popleft()
+                slot = free[0]
+                self._slots[slot] = seq
+                seq.slot = slot
+            try:
+                self._admit(seq, slot)
+            except BaseException as exc:  # noqa: BLE001
+                self.engine._allocator.free(slot)
+                self._slots[slot] = None
+                seq.finished = True
+                seq.out.put(exc)
+
+    def _admit(self, seq: _Seq, slot: int) -> None:
+        eng = self.engine
+        cfg = eng.cfg
+        alloc = eng._allocator
+        prompt = seq.prompt_ids
+        n = len(prompt)
+        need = alloc.pages_needed(min(n + seq.budget, eng.max_seq_len))
+        pages = alloc.alloc(slot, need)
+
+        with METRICS.span("prefill", jax_trace=True):
+            from fei_tpu.engine.engine import _next_bucket
+
+            bucket = min(_next_bucket(n), eng.max_seq_len)
+            dense = KVCache.create(cfg, 1, bucket, dtype=eng.dtype)
+            last_logits, dense = eng.prefill([prompt], dense)
+            last_logits.block_until_ready()
+
+        # first token sampled on the request's own key chain, exactly like
+        # the dense single-stream prologue (engine._prefill_sample)
+        mask = self._host_mask(seq, first=True)
+        if mask is not None:
+            last_logits = jnp.where(jnp.asarray(mask)[None, :], last_logits, -jnp.inf)
+        rng = jax.random.PRNGKey(seq.gen.seed)
+        rng, sub = jax.random.split(rng)
+        tok0 = int(
+            sample_logits(
+                last_logits, sub,
+                temperature=seq.gen.temperature,
+                top_k=seq.gen.top_k, top_p=seq.gen.top_p,
+            )[0]
+        )
+
+        # prompt K/V → pages + block-table row + length, pool donated
+        n_prompt_pages = alloc.pages_needed(n)
+        width = self._pool.block_table.shape[1]
+        row = np.zeros((width,), dtype=np.int32)
+        row[: len(pages)] = pages
+        admit_fn = self._admit_fn(bucket, n_prompt_pages)
+        self._pool = admit_fn(
+            self._pool, dense.k, dense.v,
+            jnp.asarray(pages[:n_prompt_pages], dtype=jnp.int32),
+            jnp.asarray(row),
+            jnp.int32(slot), jnp.int32(n),
+        )
+        self._keys = self._keys.at[slot].set(rng)
+
+        if seq.budget <= 0 or tok0 in seq.stops:
+            self._finish(seq)
+            return
+        seq.generated.append(tok0)
+        seq.out.put(tok0)
+        seq.next_input = tok0
+        if len(seq.generated) >= seq.budget:
+            self._finish(seq)
+
+    def _step_active(self) -> None:
+        eng = self.engine
+        B, V = self.B, eng.cfg.vocab_size
+        # evaluate per-request masks FIRST: a user mask_fn that raises (or
+        # returns an over-wide mask) must kill only its own request, never
+        # the other in-flight sequences or the pool
+        masks: dict[int, np.ndarray] = {}
+        for b, s in list(enumerate(self._slots)):
+            if s is None or s.mask_fn is None:
+                continue
+            try:
+                m = self._host_mask(s)
+            except BaseException as exc:  # noqa: BLE001
+                s.out.put(exc)
+                self._finish(s)
+                continue
+            if m is not None:
+                masks[b] = m
+        if not any(self._slots):
+            return
+
+        tokens = np.zeros((B, 1), dtype=np.int32)
+        temps = np.zeros((B,), dtype=np.float32)
+        topks = np.zeros((B,), dtype=np.int32)
+        topps = np.ones((B,), dtype=np.float32)
+        masked = bool(masks)
+        mask = np.ones((B, V), dtype=bool) if masked else None
+        for b, s in enumerate(self._slots):
+            if s is None:
+                continue
+            tokens[b, 0] = s.next_input
+            temps[b] = s.gen.temperature
+            topks[b] = s.gen.top_k
+            topps[b] = s.gen.top_p
+            if masked and b in masks:
+                mask[b] = masks[b]
+
+        step = self._step_fn(masked)
+        args = [eng.params, self._pool, jnp.asarray(tokens), self._keys,
+                jnp.asarray(temps), jnp.asarray(topks), jnp.asarray(topps)]
+        if masked:
+            args.append(jnp.asarray(mask))
+        with METRICS.span("decode_step"):
+            nxt, self._pool, self._keys = step(*args)
+            toks = np.asarray(nxt)  # host sync inside the span
+
+        for b, s in list(enumerate(self._slots)):
+            if s is None:
+                continue
+            t = int(toks[b])
+            if t in s.stops:
+                self._finish(s)
+                continue
+            s.generated.append(t)
+            s.out.put(t)
+            s.next_input = t
+            if len(s.generated) >= s.budget:
+                self._finish(s)
+
+    def _finish(self, seq: _Seq) -> None:
+        seq.finished = True
+        slot = seq.slot
+        if slot >= 0 and self._slots[slot] is seq:
+            if self._evict_jit is None:
+                width = self._pool.block_table.shape[1]
+
+                def evict(pool, slot_idx):
+                    bt = jax.lax.dynamic_update_slice(
+                        pool.block_table,
+                        jnp.zeros((1, width), dtype=jnp.int32),
+                        (slot_idx, 0),
+                    )
+                    ln = jax.lax.dynamic_update_slice(
+                        pool.lengths, jnp.zeros((1,), dtype=jnp.int32), (slot_idx,)
+                    )
+                    return pool._replace(block_table=bt, lengths=ln)
+
+                self._evict_jit = jax.jit(evict, donate_argnums=(0,))
+            self._pool = self._evict_jit(self._pool, jnp.int32(slot))
+            self.engine._allocator.free(slot)
+            self._slots[slot] = None
+        seq.out.put(_DONE)
+
+    def _fail_all(self, exc: BaseException) -> None:
+        """A device failure mid-step leaves the donated pool unusable: drop
+        it (recreated on next admission) instead of persisting dead arrays
+        (round-1 advisory on _release_paged)."""
+        with self._lock:
+            doomed = [s for s in self._slots if s is not None] + list(self._waiting)
+            self._waiting.clear()
+            for b in range(self.B):
+                if self._slots[b] is not None:
+                    self.engine._allocator.free(b)
+                    self._slots[b] = None
+        self._pool = None
+        self.engine._pool = None
+        for s in doomed:
+            s.finished = True
+            s.out.put(exc)
+
+    # -- device programs ----------------------------------------------------
+
+    def _ensure_pool(self) -> None:
+        # under self._lock: two submitter threads must not double-create the
+        # pool (the second would clobber a live pool and zero live PRNG keys)
+        with self._lock:
+            if self._pool is None:
+                self._pool = self.engine._ensure_pool()
+                self.engine._pool = None  # scheduler owns the arrays now
+                self._keys = jnp.zeros((self.B, 2), dtype=jnp.uint32)
+
+    def _host_mask(self, seq: _Seq, first: bool = False) -> np.ndarray | None:
+        if seq.mask_fn is None:
+            return None
+        m = seq.mask_fn([] if first else seq.generated)
+        if m is None:
+            return None
+        from fei_tpu.engine.engine import pad_vocab_mask
+
+        return pad_vocab_mask(
+            np.asarray(m, dtype=bool), self.engine.cfg.vocab_size, xp=np
+        )
+
+    def _admit_fn(self, bucket: int, n_pages: int):
+        key = (bucket, n_pages)
+        if key not in self._admit_jit:
+            cfg = self.engine.cfg
+            ps = self.engine.page_size
+
+            def admit(pool, k_dense, v_dense, page_ids, row, slot, length):
+                # k_dense/v_dense: [L, 1, S, K, D] with S = bucket
+                L, _, S, K, D = k_dense.shape
+                need = n_pages * ps
+
+                def pagesof(x):
+                    if S >= need:
+                        x = x[:, :, :need]
+                    else:
+                        x = jnp.pad(
+                            x, ((0, 0), (0, 0), (0, need - S), (0, 0), (0, 0))
+                        )
+                    # [L, 1, n*ps, K, D] -> [n, L, K, ps, D]
+                    x = x.reshape(L, n_pages, ps, K, D)
+                    return jnp.transpose(x, (1, 0, 3, 2, 4))
+
+                kp, vp = pagesof(k_dense), pagesof(v_dense)
+                k_pool, v_pool = pool.k_pages, pool.v_pages
+                for i in range(n_pages):
+                    at = (0, page_ids[i], 0, 0, 0)
+                    k_pool = jax.lax.dynamic_update_slice(
+                        k_pool, kp[i][:, None].astype(k_pool.dtype), at
+                    )
+                    v_pool = jax.lax.dynamic_update_slice(
+                        v_pool, vp[i][:, None].astype(v_pool.dtype), at
+                    )
+                bt = jax.lax.dynamic_update_slice(
+                    pool.block_table, row[None, :], (slot, 0)
+                )
+                ln = jax.lax.dynamic_update_slice(
+                    pool.lengths, length[None], (slot,)
+                )
+                return pool._replace(
+                    k_pages=k_pool, v_pages=v_pool, block_table=bt, lengths=ln
+                )
+
+            # only the pool is donated: the dense prefill K/V are reshaped
+            # (layout change), so XLA could not reuse their buffers anyway
+            self._admit_jit[key] = jax.jit(admit, donate_argnums=(0,))
+        return self._admit_jit[key]
+
+    def _step_fn(self, masked: bool):
+        key = (masked,)
+        if key not in self._step_jit:
+            cfg = self.engine.cfg
+
+            def step(params, pool, tokens, keys, temps, topks, topps, mask=None):
+                logits, pool = forward_paged(params, cfg, tokens, pool)
+                logits = logits[:, -1, :]
+                if masked:
+                    logits = jnp.where(mask, logits, -jnp.inf)
+                outs = jax.vmap(jax.random.split)(keys)  # [B, 2, 2]
+                new_keys, subs = outs[:, 0], outs[:, 1]
+                nxt = sample_logits_dynamic(logits, subs, temps, topks, topps)
+                return nxt, pool, new_keys
+
+            self._step_jit[key] = jax.jit(step, donate_argnums=(1,))
+        return self._step_jit[key]

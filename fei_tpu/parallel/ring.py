@@ -38,21 +38,26 @@ def _block_attend(q, k, v, q_pos, k_pos, scale):
     """
     B, Tq, H, D = q.shape
     K = k.shape[2]
-    groups = H // K
-    kr = jnp.repeat(k, groups, axis=2)  # [B, Sk, H, D]
-    vr = jnp.repeat(v, groups, axis=2)
-
-    s = jnp.einsum(
-        "bthd,bshd->bths", q.astype(jnp.float32), kr.astype(jnp.float32)
-    ) * scale
-    mask = (k_pos[None, None, None, :] <= q_pos[None, :, None, None])
+    G = H // K
+    # grouped-head layout instead of repeating K/V to H heads: repeat would
+    # multiply per-device attention memory by H/K (4-8x under llama GQA) and
+    # defeat ring attention's O(T/n) memory goal (round-1 advisory)
+    qg = q.reshape(B, Tq, K, G, D).astype(jnp.float32)
+    s = jnp.einsum("btkgd,bskd->btkgs", qg, k.astype(jnp.float32)) * scale
+    mask = (
+        k_pos[None, None, None, None, :] <= q_pos[None, :, None, None, None]
+    )
     s = jnp.where(mask, s, NEG_INF)
 
-    m = jnp.max(s, axis=-1, keepdims=True)  # [B, Tq, H, 1]
+    m = jnp.max(s, axis=-1, keepdims=True)  # [B, Tq, K, G, 1]
     p = jnp.exp(s - m)
     l = jnp.sum(p, axis=-1, keepdims=True)
-    acc = jnp.einsum("bths,bshd->bthd", p, vr.astype(jnp.float32))
-    return m, l, acc
+    acc = jnp.einsum("btkgs,bskd->btkgd", p, v.astype(jnp.float32))
+    return (
+        m.reshape(B, Tq, H, 1),
+        l.reshape(B, Tq, H, 1),
+        acc.reshape(B, Tq, H, D),
+    )
 
 
 def _ring_attention_shard(q, k, v, *, axis_name: str, scale: float):

@@ -140,6 +140,115 @@ class TestCharDFA:
         assert _accepts(tg, '{"v":null}')
         assert not _accepts(tg, '{"v":3}')
 
+    def test_optional_properties_skippable(self, tok):
+        """Properties absent from ``required`` may be skipped (in schema
+        order); required ones may not — round-1 advisory finding."""
+        tg = compile_tool_call_grammar(
+            {
+                "type": "object",
+                "properties": {
+                    "pattern": {"type": "string"},
+                    "path": {"type": "string"},
+                    "limit": {"type": "integer"},
+                },
+                "required": ["pattern"],
+            },
+            tok,
+        )
+        assert _accepts(tg, '{"pattern":"x"}')
+        assert _accepts(tg, '{"pattern":"x","limit":3}')
+        assert _accepts(tg, '{"pattern":"x","path":"p"}')
+        assert _accepts(tg, '{"pattern":"x","path":"p","limit":3}')
+        assert not _accepts(tg, '{"path":"p"}')  # missing required
+        assert not _accepts(tg, '{}')
+        assert not _accepts(tg, '{"limit":3,"pattern":"x"}')  # order fixed
+
+    def test_all_optional_allows_empty_object(self, tok):
+        tg = compile_tool_call_grammar(
+            {
+                "type": "object",
+                "properties": {"a": {"type": "integer"}, "b": {"type": "boolean"}},
+                "required": [],
+            },
+            tok,
+        )
+        assert _accepts(tg, "{}")
+        assert _accepts(tg, '{"a":1}')
+        assert _accepts(tg, '{"b":true}')
+        assert _accepts(tg, '{"a":1,"b":false}')
+        assert not _accepts(tg, '{"b":false,"a":1}')
+
+    def test_no_required_key_keeps_all_mandatory(self, tok):
+        """Without a ``required`` list the generator still emits every
+        property (deterministic reading of unannotated schemas)."""
+        tg = compile_tool_call_grammar(
+            {
+                "type": "object",
+                "properties": {"a": {"type": "integer"}, "b": {"type": "boolean"}},
+            },
+            tok,
+        )
+        assert _accepts(tg, '{"a":1,"b":true}')
+        assert not _accepts(tg, '{"a":1}')
+
+    def test_unknown_required_name_rejected(self, tok):
+        """A required name not present in properties is a schema bug; the
+        compiler must fail loudly, not silently make everything optional."""
+        from fei_tpu.utils.errors import EngineError
+
+        with pytest.raises(EngineError):
+            compile_tool_call_grammar(
+                {
+                    "type": "object",
+                    "properties": {"query": {"type": "string"}},
+                    "required": ["Query"],
+                },
+                tok,
+            )
+
+    def test_shared_prefix_property_names(self, tok):
+        tg = compile_tool_call_grammar(
+            {
+                "type": "object",
+                "properties": {
+                    "file": {"type": "string"},
+                    "file_path": {"type": "string"},
+                },
+                "required": ["file_path"],
+            },
+            tok,
+        )
+        assert _accepts(tg, '{"file":"a","file_path":"b"}')
+        assert _accepts(tg, '{"file_path":"b"}')
+        assert not _accepts(tg, '{"file":"a"}')
+
+    def test_optional_schema_constrained_decode_parses(self, tok):
+        """Sampled constrained decode over an optional-property schema must
+        always produce schema-valid JSON (required present, order kept)."""
+        engine = InferenceEngine.from_config(
+            "tiny", dtype=jnp.float32, seed=0, tokenizer="byte",
+            max_seq_len=256, num_layers=2,
+        )
+        schema = {
+            "type": "object",
+            "properties": {
+                "query": {"type": "string"},
+                "recursive": {"type": "boolean"},
+                "limit": {"type": "integer"},
+            },
+            "required": ["query"],
+        }
+        tg = compile_tool_call_grammar(schema, engine.tokenizer)
+        for seed in (0, 1, 2, 3):
+            gen = GenerationConfig(max_new_tokens=100, temperature=1.2, seed=seed)
+            res = engine.generate(
+                engine.tokenizer.encode("go:"), gen,
+                logit_mask_fn=tg.logit_mask_fn(max_tokens=100),
+            )
+            obj = json.loads(res.text)
+            assert "query" in obj
+            assert set(obj) <= {"query", "recursive", "limit"}
+
     def test_stop_only_at_accept(self, tok):
         tg = compile_tool_call_grammar(
             {"type": "object", "properties": {"n": {"type": "integer"}}}, tok

@@ -95,19 +95,18 @@ class TestPagedEngine:
         assert first == second
         assert paged._allocator.free_pages == paged._allocator.num_pages - 1
 
-    def test_concurrent_paged_streams_rejected(self, engines):
+    def test_abandoned_stream_does_not_wedge(self, engines):
+        """Closing (or abandoning) a stream mid-generation must return its
+        slot and pages so later generations run — round-1 advisory."""
         _, paged = engines
         prompt = paged.tokenizer.encode("hello")
         gen = GenerationConfig(max_new_tokens=8, temperature=0.0, ignore_eos=True)
         a = paged.generate_stream(prompt, gen)
         next(a)
-        b = paged.generate_stream(prompt, gen)
-        with pytest.raises(EngineError):
-            next(b)
-        a.close()  # releases seq 0's pages
+        a.close()  # cancels the request; scheduler evicts asynchronously
+        # engine stays usable: a full generation completes afterwards
+        assert len(paged.generate(prompt, gen).token_ids) == 8
         assert paged._allocator.free_pages == paged._allocator.num_pages - 1
-        # engine is usable again after the close
-        assert len(paged.generate(prompt, gen).token_ids) > 0
 
     def test_small_pool_exhaustion(self):
         eng = InferenceEngine.from_config(
@@ -116,11 +115,13 @@ class TestPagedEngine:
         )
         prompt = eng.tokenizer.encode("a long enough prompt to need pages")
         gen = GenerationConfig(max_new_tokens=64, temperature=0.0, ignore_eos=True)
+        # needs more pages than the pool will EVER have -> immediate error
         with pytest.raises(EngineError):
             eng.generate(prompt, gen)
-        # failed allocation must not leak pages or wedge the engine
+        # failed submission must not leak pages or wedge the engine
         assert eng._allocator.free_pages == eng._allocator.num_pages - 1
-        assert not eng._paged_busy
+        small = GenerationConfig(max_new_tokens=4, temperature=0.0, ignore_eos=True)
+        assert len(eng.generate(prompt[:8], small).token_ids) == 4
 
     def test_crossing_page_boundary(self, engines):
         dense, paged = engines
@@ -156,3 +157,123 @@ class TestPagedEngine:
         # 17 prompt tokens -> 2 pages; 17+8=25 tokens -> 2 pages total needed
         assert len(eng._allocator.pages_for(0)) == 2
         stream.close()
+
+
+class TestContinuousBatching:
+    """The decode scheduler: N concurrent sequences share one page pool and
+    one batched paged step (VERDICT round-1 item 3). Concurrency must never
+    change any sequence's output — each request keeps its own PRNG chain."""
+
+    @pytest.fixture(scope="class")
+    def engines(self):
+        kw = dict(
+            dtype=jnp.float32, seed=0, tokenizer="byte",
+            max_seq_len=128, num_layers=2,
+        )
+        dense = InferenceEngine.from_config("tiny", **kw)
+        paged = InferenceEngine.from_config(
+            "tiny", paged=True, page_size=16, batch_size=4, **kw
+        )
+        return dense, paged
+
+    def test_four_interleaved_streams_match_dense(self, engines):
+        dense, paged = engines
+        prompts = [
+            paged.tokenizer.encode(t)
+            for t in ("alpha", "bravo stream two", "charlie", "delta four!")
+        ]
+        gen = GenerationConfig(max_new_tokens=16, temperature=0.0, ignore_eos=True)
+        want = [dense.generate(p, gen).token_ids for p in prompts]
+
+        streams = [paged.generate_stream(p, gen) for p in prompts]
+        got = [[] for _ in prompts]
+        live = set(range(len(prompts)))
+        # round-robin: pull one token from each live stream per pass so all
+        # four sequences are demonstrably in flight at once
+        while live:
+            for i in sorted(live):
+                try:
+                    got[i].append(next(streams[i]))
+                except StopIteration:
+                    live.discard(i)
+        assert got == want
+        assert paged._allocator.free_pages == paged._allocator.num_pages - 1
+
+    def test_more_requests_than_slots_queue_fifo(self, engines):
+        dense, paged = engines
+        gen = GenerationConfig(max_new_tokens=8, temperature=0.0, ignore_eos=True)
+        prompts = [paged.tokenizer.encode(f"request {i}") for i in range(6)]
+        want = [dense.generate(p, gen).token_ids for p in prompts]
+        import concurrent.futures as cf
+
+        with cf.ThreadPoolExecutor(6) as ex:
+            got = list(
+                ex.map(lambda p: paged.generate(p, gen).token_ids, prompts)
+            )
+        assert got == want
+        assert paged._allocator.free_pages == paged._allocator.num_pages - 1
+
+    def test_sampled_streams_keep_per_request_chain(self, engines):
+        """A sampled request decoded concurrently yields the same tokens as
+        the same request decoded alone (per-sequence PRNG chains)."""
+        _, paged = engines
+        prompt = paged.tokenizer.encode("sampled")
+        gen = GenerationConfig(
+            max_new_tokens=12, temperature=0.9, seed=3, ignore_eos=True
+        )
+        alone = paged.generate(prompt, gen).token_ids
+        other_gen = GenerationConfig(
+            max_new_tokens=12, temperature=0.0, ignore_eos=True
+        )
+        other = paged.generate_stream(paged.tokenizer.encode("background"), other_gen)
+        next(other)
+        together = paged.generate(prompt, gen).token_ids
+        other.close()
+        assert together == alone
+
+    def test_bad_mask_fn_kills_only_its_request(self, engines):
+        """A raising logit_mask_fn fails its own request; concurrent
+        sequences and the pool survive."""
+        dense, paged = engines
+        gen = GenerationConfig(max_new_tokens=12, temperature=0.0, ignore_eos=True)
+        calls = {"n": 0}
+
+        def bad_mask(generated):
+            calls["n"] += 1
+            if calls["n"] > 2:
+                raise RuntimeError("mask exploded")
+            return None
+
+        good_prompt = paged.tokenizer.encode("survivor")
+        want = dense.generate(good_prompt, gen).token_ids
+        bad = paged.generate_stream(
+            paged.tokenizer.encode("doomed"), gen, logit_mask_fn=bad_mask
+        )
+        next(bad)
+        good = paged.generate_stream(good_prompt, gen)
+        with pytest.raises(RuntimeError, match="mask exploded"):
+            list(bad)
+        assert list(good) == want
+        assert paged._allocator.free_pages == paged._allocator.num_pages - 1
+
+    def test_mixed_sampling_configs_in_one_batch(self, engines):
+        dense, paged = engines
+        gens = [
+            GenerationConfig(max_new_tokens=10, temperature=0.0, ignore_eos=True),
+            GenerationConfig(max_new_tokens=10, temperature=0.8, seed=1,
+                             top_k=20, ignore_eos=True),
+            GenerationConfig(max_new_tokens=10, temperature=1.1, seed=2,
+                             top_p=0.9, ignore_eos=True),
+        ]
+        prompts = [paged.tokenizer.encode(f"mix {i}") for i in range(3)]
+        want = [dense.generate(p, g).token_ids for p, g in zip(prompts, gens)]
+        import concurrent.futures as cf
+
+        with cf.ThreadPoolExecutor(3) as ex:
+            got = list(
+                ex.map(
+                    lambda pg: paged.generate(pg[0], pg[1]).token_ids,
+                    zip(prompts, gens),
+                )
+            )
+        assert got == want
