@@ -21,6 +21,7 @@ fei/core/assistant.py:524-530). TPU-first design:
 
 from __future__ import annotations
 
+import functools
 import time
 from dataclasses import dataclass, field
 from typing import Callable, Iterator, Sequence
@@ -162,13 +163,29 @@ class InferenceEngine:
 
     # -- compiled programs --------------------------------------------------
 
+    def _moe_mesh(self):
+        """The mesh for token-routed EP inside the model forward, or None
+        when there is no ep axis (single chip / pure TP-DP meshes)."""
+        if (
+            self.mesh is not None
+            and self.cfg.is_moe
+            and self.mesh.shape.get("ep", 1) > 1
+        ):
+            return self.mesh
+        return None
+
     def _prefill_fn(self, bucket: int) -> Callable:
         key = (bucket,)
         if key not in self._prefill_cache:
             cfg = self.cfg
+            routed = self.mesh is None  # EP meshes own their routing
+            moe_mesh = self._moe_mesh()
 
             def prefill(params, tokens, cache):
-                return forward(params, cfg, tokens, cache)
+                return forward(
+                    params, cfg, tokens, cache,
+                    routed_moe=routed, moe_mesh=moe_mesh,
+                )
 
             self._prefill_cache[key] = jax.jit(prefill, donate_argnums=(2,))
         return self._prefill_cache[key]
@@ -179,10 +196,15 @@ class InferenceEngine:
         key = (gen.temperature, gen.top_k, gen.top_p)
         if key not in self._step_cache:
             cfg = self.cfg
+            routed = self.mesh is None
+            moe_mesh = self._moe_mesh()
             temperature, top_k, top_p = gen.temperature, gen.top_k, gen.top_p
 
             def step(params, cache, token, rng, logit_mask):
-                logits, cache = forward(params, cfg, token, cache)
+                logits, cache = forward(
+                    params, cfg, token, cache,
+                    routed_moe=routed, moe_mesh=moe_mesh,
+                )
                 logits = logits[:, -1, :]
                 if logit_mask is not None:
                     logits = jnp.where(logit_mask, logits, -jnp.inf)
@@ -205,7 +227,10 @@ class InferenceEngine:
         key = ("grammar", gen.temperature, gen.top_k, gen.top_p, n_steps)
         if key not in self._fused_cache:
             cfg = self.cfg
-            fwd = forward
+            fwd = functools.partial(
+                forward, routed_moe=self.mesh is None,
+                moe_mesh=self._moe_mesh(),
+            )
             temperature, top_k, top_p = gen.temperature, gen.top_k, gen.top_p
 
             def fused(params, cache, token, rng, gstate, remaining, table, min_dist):
@@ -327,7 +352,10 @@ class InferenceEngine:
         key = (gen.temperature, gen.top_k, gen.top_p, n_steps)
         if key not in self._fused_cache:
             cfg = self.cfg
-            fwd = forward
+            fwd = functools.partial(
+                forward, routed_moe=self.mesh is None,
+                moe_mesh=self._moe_mesh(),
+            )
             temperature, top_k, top_p = gen.temperature, gen.top_k, gen.top_p
 
             def fused(params, cache, token, rng):  # token: [B, 1]

@@ -25,7 +25,7 @@ import jax.numpy as jnp
 
 from fei_tpu.models.configs import ModelConfig
 from fei_tpu.ops.attention import attention
-from fei_tpu.ops.moe import moe_mlp
+from fei_tpu.ops.moe import moe_mlp, moe_mlp_routed
 from fei_tpu.ops.rmsnorm import rms_norm
 from fei_tpu.ops.rope import apply_rope, compute_rope_freqs
 
@@ -89,6 +89,48 @@ def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> dict:
 
 
 _FLASH_MIN_T = 64  # below this, kernel launch overhead beats the fusion win
+_ROUTED_MIN_TOKENS = 16  # below this, sort/gather overhead beats the k/E win
+
+
+def _moe(cfg: ModelConfig, y, lp, allow_routed: bool, moe_mesh=None):
+    """Pick the MoE formulation at trace time.
+
+    With an ``ep`` mesh (``moe_mesh``), tokens route to the devices owning
+    their experts via parallel.expert.moe_mlp_ep_routed (dispatch/combine
+    + two all_to_alls over ICI, TP-composed). Single chip:
+    FEI_TPU_ROUTED_MOE=1 forces token routing (ragged_dot grouped GEMM),
+    =0 forces the dense oracle everywhere; default "auto" routes when the
+    caller allows it and the token count amortizes the sort. Expert FLOPs
+    drop to k/E of dense when routed."""
+    mode = os.environ.get("FEI_TPU_ROUTED_MOE", "auto")
+    args = (
+        y, lp["router"], lp["w_gate"], lp["w_up"], lp["w_down"],
+        cfg.num_experts_per_tok,
+    )
+    if (
+        mode != "0"
+        and moe_mesh is not None
+        and moe_mesh.shape.get("ep", 1) > 1
+    ):
+        from fei_tpu.parallel.expert import moe_mlp_ep_routed
+
+        tp = "tp" if moe_mesh.shape.get("tp", 1) > 1 else None
+        # FEI_TPU_EP_CAPACITY: "dropless" (exact, worst-case buffers — no
+        # FLOPs saving, use for parity tests) or a capacity factor (default
+        # 2.0: expert compute = 2k/E of dense, skewed tokens beyond 2x the
+        # balanced load are dropped — standard GShard serving trade)
+        cap = os.environ.get("FEI_TPU_EP_CAPACITY", "2.0")
+        if cap == "dropless":
+            return moe_mlp_ep_routed(*args, moe_mesh, dropless=True, tp_axis=tp)
+        return moe_mlp_ep_routed(
+            *args, moe_mesh, capacity_factor=float(cap), tp_axis=tp
+        )
+    N = y.shape[0] * y.shape[1]
+    use_routed = mode == "1" or (
+        mode == "auto" and allow_routed and N >= _ROUTED_MIN_TOKENS
+    )
+    fn = moe_mlp_routed if use_routed else moe_mlp
+    return fn(*args)
 
 
 def _attend(q, k, v, kv_length, positions, allow_flash=True):
@@ -114,7 +156,10 @@ def _attend(q, k, v, kv_length, positions, allow_flash=True):
     return attention(q, k, v, positions, kv_length + T)
 
 
-def _layer(cfg: ModelConfig, x, lp, cache_k, cache_v, kv_length, positions, cos, sin):
+def _layer(
+    cfg: ModelConfig, x, lp, cache_k, cache_v, kv_length, positions, cos, sin,
+    allow_routed: bool = False, moe_mesh=None,
+):
     """One decoder block. x: [B,T,H]; cache_k/v: [B,S,K,D] (this layer's
     slice) or None for the cache-free training path.
     Returns (x_out, new_cache_k, new_cache_v)."""
@@ -146,10 +191,7 @@ def _layer(cfg: ModelConfig, x, lp, cache_k, cache_v, kv_length, positions, cos,
 
     y = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
     if cfg.is_moe:
-        mlp_out = moe_mlp(
-            y, lp["router"], lp["w_gate"], lp["w_up"], lp["w_down"],
-            cfg.num_experts_per_tok,
-        )
+        mlp_out = _moe(cfg, y, lp, allow_routed, moe_mesh)
     else:
         act = jax.nn.silu((y @ lp["w_gate"]).astype(jnp.float32)).astype(y.dtype)
         mlp_out = (act * (y @ lp["w_up"])) @ lp["w_down"]
@@ -161,6 +203,8 @@ def forward(
     cfg: ModelConfig,
     tokens: jnp.ndarray,  # [B, T] int32
     cache: KVCache,
+    routed_moe: bool = False,
+    moe_mesh=None,
 ) -> tuple[jnp.ndarray, KVCache]:
     """Run T tokens through the model against the cache.
 
@@ -176,7 +220,10 @@ def forward(
     def body(carry, layer_inputs):
         x = carry
         lp, ck, cv = layer_inputs
-        x, nk, nv = _layer(cfg, x, lp, ck, cv, cache.length, positions, cos, sin)
+        x, nk, nv = _layer(
+            cfg, x, lp, ck, cv, cache.length, positions, cos, sin,
+            allow_routed=routed_moe, moe_mesh=moe_mesh,
+        )
         return x, (nk, nv)
 
     x, (new_k, new_v) = jax.lax.scan(
@@ -195,6 +242,8 @@ def forward_paged(
     cfg: ModelConfig,
     tokens: jnp.ndarray,  # [B, 1] int32 — one decode token per sequence
     cache,  # PagedKVCache (engine/paged_cache.py)
+    routed_moe: bool = False,
+    moe_mesh=None,
 ) -> tuple[jnp.ndarray, object]:
     """Single-token decode against a paged KV cache.
 
@@ -234,10 +283,7 @@ def forward_paged(
 
         y = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
         if cfg.is_moe:
-            mlp_out = moe_mlp(
-                y, lp["router"], lp["w_gate"], lp["w_up"], lp["w_down"],
-                cfg.num_experts_per_tok,
-            )
+            mlp_out = _moe(cfg, y, lp, routed_moe, moe_mesh)
         else:
             act = jax.nn.silu((y @ lp["w_gate"]).astype(jnp.float32)).astype(y.dtype)
             mlp_out = (act * (y @ lp["w_up"])) @ lp["w_down"]

@@ -38,3 +38,50 @@ def moe_mlp(
     expert_out = jnp.einsum("beti,eih->beth", act, w_down)  # [B,E,T,H]
     out = jnp.einsum("bte,beth->bth", weights.astype(x.dtype), expert_out)
     return out
+
+
+def moe_mlp_routed(
+    x: jnp.ndarray,  # [B, T, H]
+    router_w: jnp.ndarray,  # [H, E]
+    w_gate: jnp.ndarray,  # [E, H, I]
+    w_up: jnp.ndarray,  # [E, H, I]
+    w_down: jnp.ndarray,  # [E, I, H]
+    num_experts_per_tok: int,
+) -> jnp.ndarray:
+    """Token-routed MoE: each token runs ONLY its top-k experts.
+
+    Sort-based grouped matmul: the N·k (token, expert) assignments are
+    sorted by expert so each expert's tokens are a contiguous row block,
+    then ``lax.ragged_dot`` (the TPU grouped-GEMM primitive) runs the three
+    SwiGLU matmuls over the blocks. Expert FLOPs are k/E of ``moe_mlp``
+    (≈4x saving for Mixtral top-2-of-8) with fully static shapes — the
+    sort/gather is O(N·k·H) data movement, so this path wins whenever the
+    token count is non-trivial; the dense path stays the numerical oracle
+    and the better choice for tiny decode batches.
+    """
+    B, T, H = x.shape
+    E = router_w.shape[-1]
+    k = num_experts_per_tok
+    N = B * T
+    xf = x.reshape(N, H)
+
+    logits = jnp.einsum(
+        "nh,he->ne", xf.astype(jnp.float32), router_w.astype(jnp.float32)
+    )
+    topk_vals, topk_idx = jax.lax.top_k(logits, k)  # [N, k]
+    topk_weights = jax.nn.softmax(topk_vals, axis=-1)
+
+    flat_expert = topk_idx.reshape(-1)  # [N*k]
+    order = jnp.argsort(flat_expert)  # stable: ties keep token order
+    token_of = order // k  # source token of each sorted assignment
+    xs = jnp.take(xf, token_of, axis=0)  # [N*k, H]
+    group_sizes = jnp.bincount(flat_expert, length=E).astype(jnp.int32)
+
+    gate = jax.lax.ragged_dot(xs, w_gate, group_sizes)
+    up = jax.lax.ragged_dot(xs, w_up, group_sizes)
+    act = jax.nn.silu(gate.astype(jnp.float32)).astype(x.dtype) * up
+    outs = jax.lax.ragged_dot(act, w_down, group_sizes)  # [N*k, H]
+
+    wf = jnp.take(topk_weights.reshape(-1), order).astype(x.dtype)
+    out = jnp.zeros((N, H), dtype=x.dtype).at[token_of].add(outs * wf[:, None])
+    return out.reshape(B, T, H)
