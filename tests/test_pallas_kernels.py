@@ -18,6 +18,13 @@ def _rand(key, shape, dtype=jnp.float32):
     return jax.random.normal(key, shape, dtype=jnp.float32).astype(dtype) * 0.3
 
 
+def _atol():
+    # On real TPU the MXU's default-precision fp32 matmul accumulates
+    # differently from the fp32 interpret-mode oracle; 2e-3 holds in
+    # interpret, 5e-3 on chip. Lazy so collection doesn't init the backend.
+    return 5e-3 if jax.default_backend() == "tpu" else 2e-3
+
+
 class TestFlashAttention:
     @pytest.mark.parametrize("T,S,q_start", [(16, 64, 0), (64, 64, 0), (8, 128, 40)])
     def test_matches_oracle(self, T, S, q_start):
@@ -32,7 +39,7 @@ class TestFlashAttention:
         positions = starts[:, None] + jnp.arange(T)[None, :]
         want = attention(q, k, v, positions, kv_len)
         got = flash_attention(q, k, v, starts, kv_len, block_q=32, block_k=32)
-        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-3)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=_atol())
 
     def test_ragged_batch(self):
         """Different cache offsets per sequence."""
@@ -47,7 +54,7 @@ class TestFlashAttention:
         positions = starts[:, None] + jnp.arange(T)[None, :]
         want = attention(q, k, v, positions, kv_len)
         got = flash_attention(q, k, v, starts, kv_len, block_q=8, block_k=16)
-        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-3)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=_atol())
 
     def test_unaligned_lengths_padded(self):
         """T not a multiple of block_q — wrapper pads and slices."""
@@ -62,7 +69,7 @@ class TestFlashAttention:
         positions = starts[:, None] + jnp.arange(T)[None, :]
         want = attention(q, k, v, positions, kv_len)
         got = flash_attention(q, k, v, starts, kv_len, block_q=16, block_k=16)
-        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-3)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=_atol())
 
     def test_bf16(self):
         B, T, H, K, D = 1, 32, 4, 2, 64
@@ -123,7 +130,7 @@ class TestPagedAttention:
         positions = (lengths - 1)[:, None]
         want = attention(q[:, None], kc, vc, positions, lengths)[:, 0]
         got = paged_attention(q, kp, vp, bt, lengths)
-        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-3)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=_atol())
 
     def test_single_page(self):
         B, H, K, D, page_size = 1, 2, 2, 32, 8
@@ -134,7 +141,7 @@ class TestPagedAttention:
         positions = (lengths - 1)[:, None]
         want = attention(q[:, None], kc, vc, positions, lengths)[:, 0]
         got = paged_attention(q, kp, vp, bt, lengths)
-        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-3)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=_atol())
 
     def test_full_pages(self):
         """Length exactly fills every page."""
@@ -146,4 +153,4 @@ class TestPagedAttention:
         positions = (lengths - 1)[:, None]
         want = attention(q[:, None], kc, vc, positions, lengths)[:, 0]
         got = paged_attention(q, kp, vp, bt, lengths)
-        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-3)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=_atol())
