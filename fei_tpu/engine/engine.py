@@ -140,16 +140,31 @@ class InferenceEngine:
         paged: bool = False,
         page_size: int = 64,
         num_pages: int | None = None,
+        quantize: str | None = None,
         **overrides,
     ) -> "InferenceEngine":
+        """``quantize="int8"`` converts the big linear weights to weight-only
+        int8 (ops.quant) — halves weight HBM so e.g. an 8B fits one 16 GB
+        v5e chip; norms/router/embed stay in ``dtype``."""
+        if quantize not in (None, "int8"):
+            raise ValueError(f"unsupported quantize mode: {quantize!r}")
         cfg = get_model_config(name, **overrides)
         tok = load_tokenizer(tokenizer)
         if checkpoint_dir:
             from fei_tpu.engine.weights import load_checkpoint
 
-            cfg, params = load_checkpoint(checkpoint_dir, cfg, dtype=dtype)
+            # with a mesh, each safetensors slice streams straight into its
+            # device shard (quantizing during the read) — the full bf16
+            # pytree never exists on host or on one device
+            cfg, params = load_checkpoint(
+                checkpoint_dir, cfg, dtype=dtype, mesh=mesh, quantize=quantize,
+            )
         else:
             params = init_params(cfg, jax.random.PRNGKey(seed), dtype=dtype)
+            if quantize == "int8":
+                from fei_tpu.ops.quant import quantize_params
+
+                params = jax.jit(quantize_params, donate_argnums=0)(params)
         engine = cls(
             cfg, params, tok,
             max_seq_len=max_seq_len, batch_size=batch_size, dtype=dtype,
@@ -158,7 +173,10 @@ class InferenceEngine:
         if mesh is not None:
             from fei_tpu.parallel.sharding import shard_engine
 
-            shard_engine(engine, mesh)
+            if checkpoint_dir:
+                engine.mesh = mesh  # params already landed sharded
+            else:
+                shard_engine(engine, mesh)
         return engine
 
     # -- compiled programs --------------------------------------------------

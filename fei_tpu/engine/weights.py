@@ -1,24 +1,38 @@
 """Checkpoint loading: HuggingFace-style safetensors -> stacked param pytree.
 
 Maps per-layer HF Llama/Mixtral tensor names onto the scan-stacked layout of
-models/llama.py (layers concatenated on a leading axis). Reads shard files
-lazily (at most one open at a time) so host I/O stays near one shard, but the
-stacked pytree is currently materialized on the default device before any
-mesh sharding is applied — fine up to ~host-RAM-sized models. Streaming
-layer-by-layer placement into sharded HBM (needed for 70B on a pod) is a
-planned follow-up; see the `shardings` parameter.
+models/llama.py (layers concatenated on a leading axis).
+
+Two load paths:
+
+- **Eager** (``shardings=None``): tensors are read whole and materialized on
+  the default device. Fine up to host-RAM-sized models.
+- **Streamed sharded** (``shardings=`` a pytree of NamedSharding): each
+  stacked tensor is built with ``jax.make_array_from_callback`` — the
+  callback reads exactly the safetensors *slice* a device shard needs
+  (safetensors are mmap'd, so partial reads touch only those pages) and the
+  result lands directly in that device's memory. The full stacked tensor is
+  never materialized on host, which is what lets 70B (~140 GB bf16) load
+  onto a pod from a host with far less RAM (SURVEY.md §7 hard-part #4).
+
+``quantize="int8"`` converts the big linear weights to weight-only int8
+(ops.quant.QTensor) *during* the read: scales are computed from the full
+contraction column of each requested out-channel slice, so per-channel
+scales are exact regardless of how the contraction dim is sharded.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import threading
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from fei_tpu.models.configs import ModelConfig
+from fei_tpu.ops.quant import QTensor, QUANT_KEYS
 from fei_tpu.utils.errors import CheckpointError
 from fei_tpu.utils.logging import get_logger
 
@@ -48,8 +62,7 @@ _TOP_MAP = {
     "lm_head": "lm_head.weight",
 }
 # HF stores linear weights as [out, in]; our pytree uses [in, out] so the
-# forward is x @ w. Norm/embed tensors are kept as-is.
-_TRANSPOSE = {"wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down", "router", "lm_head"}
+# forward is x @ w (the plan builders mark these transpose=True).
 
 
 def _open_index(ckpt_dir: str) -> dict[str, str]:
@@ -70,7 +83,12 @@ def _open_index(ckpt_dir: str) -> dict[str, str]:
 
 
 class _ShardReader:
-    """Keeps at most one shard file open; tensors read lazily."""
+    """Slice-level reads across shard files.
+
+    Shard files stay open (mmap — address space, not resident memory) and a
+    lock guards the open-file cache because make_array_from_callback may
+    invoke callbacks from multiple threads.
+    """
 
     def __init__(self, ckpt_dir: str, weight_map: dict[str, str]):
         from safetensors import safe_open
@@ -78,21 +96,276 @@ class _ShardReader:
         self._safe_open = safe_open
         self.dir = ckpt_dir
         self.map = weight_map
-        self._open_name: str | None = None
-        self._open_file = None
+        self._files: dict[str, object] = {}
+        self._checked: set[str] = set()
+        self._lock = threading.Lock()
 
-    def get(self, name: str) -> np.ndarray:
+    def _file(self, shard: str):
+        with self._lock:
+            if shard not in self._files:
+                self._files[shard] = self._safe_open(
+                    os.path.join(self.dir, shard), framework="np"
+                )
+            return self._files[shard]
+
+    def read(
+        self, name: str, idx: tuple, transpose: bool, expect_hf: tuple | None = None
+    ) -> np.ndarray:
+        """Read ``tensor[idx]`` where idx indexes OUR layout ([in, out] for
+        transposed linears); only the requested slice's pages are touched.
+
+        ``expect_hf``: the tensor's expected on-disk shape — validated once
+        per tensor so a config/checkpoint mismatch fails loudly instead of
+        silently truncating (slice reads would otherwise succeed on any
+        bigger tensor)."""
         if name not in self.map:
             raise CheckpointError(f"tensor {name!r} missing from checkpoint")
-        shard = self.map[name]
-        if shard != self._open_name:
-            if self._open_file is not None:
-                del self._open_file
-            self._open_file = self._safe_open(
-                os.path.join(self.dir, shard), framework="np"
+        ts = self._file(self.map[name]).get_slice(name)
+        if expect_hf is not None and name not in self._checked:
+            got = tuple(ts.get_shape())
+            if got != tuple(expect_hf):
+                raise CheckpointError(
+                    f"tensor {name!r} has shape {got}, config expects "
+                    f"{tuple(expect_hf)} — wrong model config for this checkpoint?"
+                )
+            with self._lock:
+                self._checked.add(name)
+        if transpose:
+            r, c = idx
+            return np.ascontiguousarray(ts[c, r].T)
+        if len(idx) == 1:
+            return ts[idx[0]]
+        return ts[idx]
+
+    def get(self, name: str) -> np.ndarray:
+        """Whole-tensor read (eager path)."""
+        if name not in self.map:
+            raise CheckpointError(f"tensor {name!r} missing from checkpoint")
+        return self._file(self.map[name]).get_tensor(name)
+
+
+def _full(shape: tuple) -> tuple:
+    return tuple(slice(0, s) for s in shape)
+
+
+def _norm_idx(idx: tuple, shape: tuple) -> tuple:
+    """Resolve open/None slices (replicated dims) to concrete start/stop."""
+    return tuple(
+        slice(*sl.indices(dim)[:2]) for sl, dim in zip(idx, shape)
+    )
+
+
+def _quant_host(w: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Host-side symmetric int8 over contraction axis -2 (matches
+    ops.quant.quantize)."""
+    w = w.astype(np.float32)
+    amax = np.abs(w).max(axis=-2, keepdims=True)
+    s = np.where(amax == 0.0, 1.0, amax / 127.0)
+    q = np.clip(np.round(w / s), -127, 127).astype(np.int8)
+    return q, s.astype(np.float32)
+
+
+class _TensorPlan:
+    """One logical (possibly stacked) tensor: global shape + slice reader."""
+
+    def __init__(self, shape: tuple, read):
+        self.shape = shape
+        self.read = read  # read(idx: tuple[slice,...]) -> np.ndarray
+
+
+def _plans(reader: _ShardReader, cfg: ModelConfig) -> dict:
+    """Build {path: _TensorPlan} for the whole pytree. Shapes come from the
+    config and are validated against the safetensors header on first read
+    (reader.read's expect_hf)."""
+    h, d = cfg.hidden_size, cfg.head_dim_
+    H, K, I = cfg.num_heads, cfg.num_kv_heads, cfg.intermediate_size
+    L, V = cfg.num_layers, cfg.vocab_size
+
+    def hf_shape(shape, transpose):
+        return tuple(reversed(shape)) if transpose and len(shape) == 2 else shape
+
+    def top(name, shape, transpose):
+        hf = _TOP_MAP[name]
+        expect = hf_shape(shape, transpose)
+        return _TensorPlan(
+            shape, lambda idx: reader.read(hf, idx, transpose, expect)
+        )
+
+    def stacked(tmpl, per_layer_shape, transpose):
+        expect = hf_shape(per_layer_shape, transpose)
+
+        def read(idx):
+            lsl, *rest = idx
+            rest = tuple(rest)
+            return np.stack(
+                [
+                    reader.read(tmpl.format(i=i), rest, transpose, expect)
+                    for i in range(lsl.start or 0, lsl.stop)
+                ]
             )
-            self._open_name = shard
-        return self._open_file.get_tensor(name)
+
+        return _TensorPlan((L, *per_layer_shape), read)
+
+    def stacked_experts(tmpl, per_expert_shape):
+        E = cfg.num_experts
+        expect = hf_shape(per_expert_shape, True)
+
+        def read(idx):
+            lsl, esl, *rest = idx
+            rest = tuple(rest)
+            return np.stack(
+                [
+                    np.stack(
+                        [
+                            reader.read(tmpl.format(i=i, e=e), rest, True, expect)
+                            for e in range(esl.start or 0, esl.stop)
+                        ]
+                    )
+                    for i in range(lsl.start or 0, lsl.stop)
+                ]
+            )
+
+        return _TensorPlan((L, E, *per_expert_shape), read)
+
+    plans = {
+        ("embed",): top("embed", (V, h), False),
+        ("final_norm",): top("final_norm", (h,), False),
+        ("layers", "attn_norm"): stacked(_LAYER_MAP["attn_norm"], (h,), False
+        ),
+        ("layers", "mlp_norm"): stacked(_LAYER_MAP["mlp_norm"], (h,), False
+        ),
+        ("layers", "wq"): stacked(_LAYER_MAP["wq"], (h, H * d), True),
+        ("layers", "wk"): stacked(_LAYER_MAP["wk"], (h, K * d), True),
+        ("layers", "wv"): stacked(_LAYER_MAP["wv"], (h, K * d), True),
+        ("layers", "wo"): stacked(_LAYER_MAP["wo"], (H * d, h), True),
+    }
+    if not cfg.tie_embeddings:
+        plans[("lm_head",)] = top("lm_head", (h, V), True)
+    if cfg.is_moe:
+        plans[("layers", "router")] = stacked(_MOE_LAYER_MAP["router"], (h, cfg.num_experts), True
+        )
+        plans[("layers", "w_gate")] = stacked_experts(_MOE_LAYER_MAP["w_gate"], (h, I)
+        )
+        plans[("layers", "w_up")] = stacked_experts(_MOE_LAYER_MAP["w_up"], (h, I)
+        )
+        plans[("layers", "w_down")] = stacked_experts(_MOE_LAYER_MAP["w_down"], (I, h)
+        )
+    else:
+        plans[("layers", "w_gate")] = stacked(_LAYER_MAP["w_gate"], (h, I), True
+        )
+        plans[("layers", "w_up")] = stacked(_LAYER_MAP["w_up"], (h, I), True
+        )
+        plans[("layers", "w_down")] = stacked(_LAYER_MAP["w_down"], (I, h), True
+        )
+    return plans
+
+
+def _lookup(tree, path: tuple):
+    for p in path:
+        if not isinstance(tree, dict) or p not in tree:
+            return None
+        tree = tree[p]
+    return tree
+
+
+def _build_plain(plan: _TensorPlan, dtype, sharding):
+    np_dtype = np.dtype(jnp.dtype(dtype))  # bf16 via ml_dtypes registration
+    if sharding is None:
+        return jnp.asarray(plan.read(_full(plan.shape)), dtype=dtype)
+    # callbacks return numpy so each shard transfers host->device once,
+    # straight to its target device (no default-device bounce)
+    return jax.make_array_from_callback(
+        plan.shape, sharding,
+        lambda idx: plan.read(_norm_idx(idx, plan.shape)).astype(np_dtype),
+    )
+
+
+def _build_quantized(plan: _TensorPlan, sharding) -> QTensor:
+    """int8 QTensor; scales computed from the full contraction column so a
+    contraction-sharded weight (row-parallel wo/w_down) still gets exact
+    global per-out-channel scales on every shard.
+
+    Reads are memoized per out-channel slice: the q and s callbacks for the
+    same shard (and replicated shards) hit one disk read + quantization.
+    The memo lives only for this tensor's build, so host peak stays at one
+    int8 tensor."""
+    shape = plan.shape
+    s_shape = (*shape[:-2], 1, shape[-1])
+    memo: dict[tuple, tuple] = {}
+    inflight: dict[tuple, threading.Event] = {}
+    lock = threading.Lock()
+
+    def compute(idx_wo_contraction):
+        # read + quantize per leading-axis step (layer), not whole-tensor:
+        # scales only need the full contraction column of one layer at a
+        # time, so fp32 peak is one layer's weights even for row-parallel
+        # shards whose slice spans every layer
+        widx = list(idx_wo_contraction)
+        widx.insert(len(widx) - 1, slice(0, shape[-2]))
+        if len(shape) >= 3:
+            lead = idx_wo_contraction[0]
+            qs, ss = [], []
+            for layer in range(lead.start, lead.stop):
+                widx[0] = slice(layer, layer + 1)
+                q1, s1 = _quant_host(plan.read(tuple(widx)))
+                qs.append(q1)
+                ss.append(s1)
+            return np.concatenate(qs), np.concatenate(ss)
+        return _quant_host(plan.read(tuple(widx)))
+
+    def quant_cols(idx_wo_contraction):
+        # idx_wo_contraction: normalized slices of every dim except the
+        # contraction (-2), which is always read in full for exact scales.
+        # Same-key callbacks (q+s of one shard, replicated shards) share one
+        # compute: the first becomes owner, the rest wait on its event.
+        key = tuple((sl.start, sl.stop) for sl in idx_wo_contraction)
+        with lock:
+            if key in memo:
+                return memo[key]
+            ev = inflight.get(key)
+            if ev is None:
+                inflight[key] = ev = threading.Event()
+                owner = True
+            else:
+                owner = False
+        if not owner:
+            ev.wait()
+            with lock:
+                hit = memo.get(key)
+            if hit is None:  # owner's read raised; surface a clear error
+                raise CheckpointError(
+                    f"concurrent quantized read for slice {key} failed in owner"
+                )
+            return hit
+        try:
+            result = compute(idx_wo_contraction)
+            with lock:
+                memo[key] = result
+            return result
+        finally:
+            ev.set()
+            with lock:
+                inflight.pop(key, None)
+
+    def read_q(idx):
+        idx = _norm_idx(idx, shape)
+        q, _ = quant_cols(idx[:-2] + idx[-1:])
+        return q[..., idx[-2], :]
+
+    def read_s(idx):
+        idx = _norm_idx(idx, s_shape)
+        _, s = quant_cols(idx[:-2] + idx[-1:])
+        return s
+
+    if sharding is None:
+        full = _full(shape)
+        q, s = quant_cols(full[:-2] + full[-1:])
+        return QTensor(q=jnp.asarray(q), s=jnp.asarray(s))
+
+    q_shard, s_shard = sharding  # (weight sharding, scale sharding)
+    q = jax.make_array_from_callback(shape, q_shard, read_q)
+    s = jax.make_array_from_callback(s_shape, s_shard, read_s)
+    return QTensor(q=q, s=s)
 
 
 def load_checkpoint(
@@ -100,63 +373,63 @@ def load_checkpoint(
     cfg: ModelConfig,
     dtype=jnp.bfloat16,
     shardings: dict | None = None,
+    quantize: str | None = None,
+    mesh=None,
 ) -> tuple[ModelConfig, dict]:
     """Load an HF llama/mixtral safetensors dir into the stacked pytree.
 
     If a config.json is present, architecture fields override ``cfg`` so the
     checkpoint is self-describing.
+
+    ``shardings``: optional pytree matching the param tree whose leaves are
+    NamedSharding (as produced by parallel.sharding.param_shardings on the
+    *unquantized* structure — plain NamedSharding leaves; QTensor sharding
+    pairs are derived here). Enables the streamed per-shard read path.
+
+    ``mesh``: convenience alternative to ``shardings`` — the canonical
+    TP/EP shardings are derived here from the (HF-merged) config.
+
+    ``quantize="int8"``: big linear weights land as ops.quant.QTensor.
     """
+    if quantize not in (None, "int8"):
+        raise CheckpointError(f"unsupported quantize mode: {quantize!r}")
     cfg = _merge_hf_config(ckpt_dir, cfg)
+    if shardings is None and mesh is not None:
+        from fei_tpu.parallel.sharding import param_shardings_from_cfg
+
+        shardings = param_shardings_from_cfg(cfg, mesh)
     reader = _ShardReader(ckpt_dir, _open_index(ckpt_dir))
+    plans = _plans(reader, cfg)
 
-    def put(arr: np.ndarray, path: tuple, transpose: bool) -> jax.Array:
-        if transpose:
-            arr = np.ascontiguousarray(arr.T)
-        out = jnp.asarray(arr, dtype=dtype)
-        if shardings is not None and path in shardings:
-            out = jax.device_put(out, shardings[path])
-        return out
+    params: dict = {"layers": {}}
+    for path, plan in plans.items():
+        shard = _lookup(shardings, path) if shardings is not None else None
+        key = path[-1]
+        if quantize == "int8" and key in QUANT_KEYS:
+            if shard is not None:
+                from fei_tpu.parallel.sharding import _scale_spec
+                from jax.sharding import NamedSharding
 
-    params: dict = {}
-    for ours, hf in _TOP_MAP.items():
-        if ours == "lm_head" and cfg.tie_embeddings:
-            continue
-        params[ours] = put(reader.get(hf), (ours,), ours in _TRANSPOSE)
+                s_shape = (*plan.shape[:-2], 1, plan.shape[-1])
+                s_shard = NamedSharding(
+                    shard.mesh, _scale_spec(shard.spec, s_shape)
+                )
+                leaf = _build_quantized(plan, (shard, s_shard))
+            else:
+                leaf = _build_quantized(plan, None)
+        else:
+            leaf = _build_plain(plan, dtype, shard)
+        if path[0] == "layers":
+            params["layers"][path[1]] = leaf
+        else:
+            params[path[0]] = leaf
 
-    layers: dict = {}
-    layer_map = dict(_LAYER_MAP)
-    if cfg.is_moe:
-        # dense-MLP names don't exist in MoE checkpoints; router stacks like
-        # any per-layer tensor, experts add a nested per-expert loop below
-        for k in ("w_gate", "w_up", "w_down"):
-            del layer_map[k]
-        layer_map["router"] = _MOE_LAYER_MAP["router"]
-    for ours, tmpl in layer_map.items():
-        stack = [
-            put(reader.get(tmpl.format(i=i)), ("layers", ours, i), ours in _TRANSPOSE)
-            for i in range(cfg.num_layers)
-        ]
-        layers[ours] = jnp.stack(stack)
-    if cfg.is_moe:
-        for ours in ("w_gate", "w_up", "w_down"):
-            tmpl = _MOE_LAYER_MAP[ours]
-            layers[ours] = jnp.stack(
-                [
-                    jnp.stack(
-                        [
-                            put(
-                                reader.get(tmpl.format(i=i, e=e)),
-                                ("layers", ours, i, e),
-                                True,
-                            )
-                            for e in range(cfg.num_experts)
-                        ]
-                    )
-                    for i in range(cfg.num_layers)
-                ]
-            )
-    params["layers"] = layers
-    log.info("loaded checkpoint from %s (%d layers)", ckpt_dir, cfg.num_layers)
+    log.info(
+        "loaded checkpoint from %s (%d layers%s%s)",
+        ckpt_dir, cfg.num_layers,
+        ", streamed-sharded" if shardings is not None else "",
+        ", int8" if quantize == "int8" else "",
+    )
     return cfg, params
 
 

@@ -23,6 +23,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+from fei_tpu.ops.quant import dequantize, mm
 from fei_tpu.models.configs import ModelConfig
 from fei_tpu.ops.attention import attention
 from fei_tpu.ops.moe import moe_mlp, moe_mlp_routed
@@ -103,8 +104,13 @@ def _moe(cfg: ModelConfig, y, lp, allow_routed: bool, moe_mesh=None):
     caller allows it and the token count amortizes the sort. Expert FLOPs
     drop to k/E of dense when routed."""
     mode = os.environ.get("FEI_TPU_ROUTED_MOE", "auto")
+    # int8 expert weights are dequantized per-layer here (one layer's experts
+    # at a time inside the scan; XLA fuses the convert into the expert GEMMs)
     args = (
-        y, lp["router"], lp["w_gate"], lp["w_up"], lp["w_down"],
+        y, lp["router"],
+        dequantize(lp["w_gate"], y.dtype),
+        dequantize(lp["w_up"], y.dtype),
+        dequantize(lp["w_down"], y.dtype),
         cfg.num_experts_per_tok,
     )
     if (
@@ -168,9 +174,9 @@ def _layer(
     Hq = cfg.num_heads
 
     y = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
-    q = (y @ lp["wq"]).reshape(B, T, Hq, d)
-    k = (y @ lp["wk"]).reshape(B, T, K, d)
-    v = (y @ lp["wv"]).reshape(B, T, K, d)
+    q = mm(y, lp["wq"]).reshape(B, T, Hq, d)
+    k = mm(y, lp["wk"]).reshape(B, T, K, d)
+    v = mm(y, lp["wv"]).reshape(B, T, K, d)
     q = apply_rope(q, cos, sin, positions)
     k = apply_rope(k, cos, sin, positions)
 
@@ -187,15 +193,22 @@ def _layer(
     attn_out = _attend(
         q, new_k, new_v, kv_length, positions, allow_flash=cache_k is not None
     )
-    x = x + attn_out.reshape(B, T, Hq * d) @ lp["wo"]
+    x = x + mm(attn_out.reshape(B, T, Hq * d), lp["wo"])
 
     y = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
     if cfg.is_moe:
         mlp_out = _moe(cfg, y, lp, allow_routed, moe_mesh)
     else:
-        act = jax.nn.silu((y @ lp["w_gate"]).astype(jnp.float32)).astype(y.dtype)
-        mlp_out = (act * (y @ lp["w_up"])) @ lp["w_down"]
+        act = jax.nn.silu(mm(y, lp["w_gate"]).astype(jnp.float32)).astype(y.dtype)
+        mlp_out = mm(act * mm(y, lp["w_up"]), lp["w_down"])
     return x + mlp_out, new_k, new_v
+
+
+def _logits(x, params, cfg: ModelConfig) -> jnp.ndarray:
+    """LM head (quantization-aware); tied embeddings stay bf16."""
+    if cfg.tie_embeddings:
+        return (x @ params["embed"].T.astype(x.dtype)).astype(jnp.float32)
+    return mm(x, params["lm_head"]).astype(jnp.float32)
 
 
 def forward(
@@ -231,8 +244,7 @@ def forward(
     )
 
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
-    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
-    logits = (x @ head.astype(x.dtype)).astype(jnp.float32)
+    logits = _logits(x, params, cfg)
     new_cache = KVCache(k=new_k, v=new_v, length=cache.length + T)
     return logits, new_cache
 
@@ -267,9 +279,9 @@ def forward_paged(
     def body(x, layer_inputs):
         lp, kp, vp = layer_inputs  # kp/vp: [P, K, ps, D] this layer's pool
         y = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
-        q = (y @ lp["wq"]).reshape(B, 1, Hq, d)
-        k = (y @ lp["wk"]).reshape(B, 1, K, d)
-        v = (y @ lp["wv"]).reshape(B, 1, K, d)
+        q = mm(y, lp["wq"]).reshape(B, 1, Hq, d)
+        k = mm(y, lp["wk"]).reshape(B, 1, K, d)
+        v = mm(y, lp["wv"]).reshape(B, 1, K, d)
         q = apply_rope(q, cos, sin, positions)
         k = apply_rope(k, cos, sin, positions)
 
@@ -279,14 +291,14 @@ def forward_paged(
         attn = paged_attention(
             q[:, 0], kp, vp, cache.block_table, cache.lengths + 1
         )  # [B, Hq, D]
-        x = x + attn.reshape(B, 1, Hq * d) @ lp["wo"]
+        x = x + mm(attn.reshape(B, 1, Hq * d), lp["wo"])
 
         y = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
         if cfg.is_moe:
             mlp_out = _moe(cfg, y, lp, routed_moe, moe_mesh)
         else:
-            act = jax.nn.silu((y @ lp["w_gate"]).astype(jnp.float32)).astype(y.dtype)
-            mlp_out = (act * (y @ lp["w_up"])) @ lp["w_down"]
+            act = jax.nn.silu(mm(y, lp["w_gate"]).astype(jnp.float32)).astype(y.dtype)
+            mlp_out = mm(act * mm(y, lp["w_up"]), lp["w_down"])
         return x + mlp_out, (kp, vp)
 
     x, (new_k, new_v) = jax.lax.scan(
@@ -294,8 +306,7 @@ def forward_paged(
     )
 
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
-    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
-    logits = (x @ head.astype(x.dtype)).astype(jnp.float32)
+    logits = _logits(x, params, cfg)
     new_cache = cache._replace(
         k_pages=new_k, v_pages=new_v, lengths=cache.lengths + 1
     )
@@ -328,5 +339,4 @@ def forward_train(
     x, _ = jax.lax.scan(body, x, params["layers"])
 
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
-    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
-    return (x @ head.astype(x.dtype)).astype(jnp.float32)
+    return _logits(x, params, cfg)

@@ -27,8 +27,9 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from fei_tpu.models.configs import ModelConfig
-from fei_tpu.models.llama import KVCache
+from fei_tpu.models.llama import KVCache, _logits
 from fei_tpu.ops.moe import moe_mlp
+from fei_tpu.ops.quant import dequantize, mm
 from fei_tpu.ops.rmsnorm import rms_norm
 from fei_tpu.ops.rope import apply_rope, compute_rope_freqs
 from fei_tpu.parallel.ring import _ring_attention_shard
@@ -48,26 +49,29 @@ def _prefill_shard(x, layers, cos, sin, *, cfg: ModelConfig, axis_name: str):
 
     def body(x, lp):
         y = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
-        q = (y @ lp["wq"]).reshape(B, C, Hq, d)
-        k = (y @ lp["wk"]).reshape(B, C, K, d)
-        v = (y @ lp["wv"]).reshape(B, C, K, d)
+        q = mm(y, lp["wq"]).reshape(B, C, Hq, d)
+        k = mm(y, lp["wk"]).reshape(B, C, K, d)
+        v = mm(y, lp["wv"]).reshape(B, C, K, d)
         q = apply_rope(q, cos, sin, positions)
         k = apply_rope(k, cos, sin, positions)
 
         attn = _ring_attention_shard(
             q, k, v, axis_name=axis_name, scale=d ** -0.5
         )
-        x = x + attn.reshape(B, C, Hq * d) @ lp["wo"]
+        x = x + mm(attn.reshape(B, C, Hq * d), lp["wo"])
 
         y = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
         if cfg.is_moe:
             mlp_out = moe_mlp(
-                y, lp["router"], lp["w_gate"], lp["w_up"], lp["w_down"],
+                y, lp["router"],
+                dequantize(lp["w_gate"], y.dtype),
+                dequantize(lp["w_up"], y.dtype),
+                dequantize(lp["w_down"], y.dtype),
                 cfg.num_experts_per_tok,
             )
         else:
-            act = jax.nn.silu((y @ lp["w_gate"]).astype(jnp.float32)).astype(y.dtype)
-            mlp_out = (act * (y @ lp["w_up"])) @ lp["w_down"]
+            act = jax.nn.silu(mm(y, lp["w_gate"]).astype(jnp.float32)).astype(y.dtype)
+            mlp_out = mm(act * mm(y, lp["w_up"]), lp["w_down"])
         return x + mlp_out, (k, v)
 
     x, (ks, vs) = jax.lax.scan(body, x, layers)
@@ -108,8 +112,7 @@ def prefill_ring(
     # last-token logits (the full x is only needed for its final position)
     last = x[:, -1, :]
     last = rms_norm(last, params["final_norm"], cfg.rms_norm_eps)
-    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
-    logits = (last @ head.astype(last.dtype)).astype(jnp.float32)
+    logits = _logits(last, params, cfg)
 
     S = max_seq_len or T
     if S < T:

@@ -24,7 +24,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from fei_tpu.models.configs import ModelConfig
-from fei_tpu.models.llama import _layer
+from fei_tpu.models.llama import _layer, _logits
 from fei_tpu.ops.rmsnorm import rms_norm
 from fei_tpu.ops.rope import compute_rope_freqs
 
@@ -127,5 +127,4 @@ def pipeline_forward_train(
     x = ys.reshape(B, T, -1)
 
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
-    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
-    return (x @ head.astype(x.dtype)).astype(jnp.float32)
+    return _logits(x, params, cfg)

@@ -54,14 +54,34 @@ def param_specs(is_moe: bool) -> dict:
     }
 
 
+def _scale_spec(spec: P, s_shape: tuple) -> P:
+    """Spec for a QTensor scale: the weight's spec with axis entries dropped
+    where the scale's dim collapsed to 1 (the contraction axis)."""
+    entries = list(spec) + [None] * (len(s_shape) - len(spec))
+    return P(*[
+        None if s_shape[i] == 1 else entries[i] for i in range(len(s_shape))
+    ])
+
+
 def _tree_shardings(specs: dict, params: dict, mesh: Mesh) -> dict:
-    """Match the spec tree to the actual param tree (lm_head may be absent)."""
+    """Match the spec tree to the actual param tree (lm_head may be absent).
+
+    Weight-only-int8 leaves (ops.quant.QTensor) get the weight's spec on the
+    int8 tensor and a contraction-axis-collapsed spec on the scale."""
+    from fei_tpu.ops.quant import QTensor
 
     def pick(spec_subtree, param_subtree):
         if isinstance(param_subtree, dict):
             return {
                 k: pick(spec_subtree[k], v) for k, v in param_subtree.items()
             }
+        if isinstance(param_subtree, QTensor):
+            return QTensor(
+                q=NamedSharding(mesh, spec_subtree),
+                s=NamedSharding(
+                    mesh, _scale_spec(spec_subtree, param_subtree.s.shape)
+                ),
+            )
         return NamedSharding(mesh, spec_subtree)
 
     return pick(specs, params)
@@ -69,6 +89,22 @@ def _tree_shardings(specs: dict, params: dict, mesh: Mesh) -> dict:
 
 def param_shardings(params: dict, mesh: Mesh, is_moe: bool) -> dict:
     return _tree_shardings(param_specs(is_moe), params, mesh)
+
+
+def param_shardings_from_cfg(cfg, mesh: Mesh) -> dict:
+    """NamedSharding tree from the model config alone (no params needed) —
+    feeds engine/weights.load_checkpoint's streamed per-shard read path so
+    a checkpoint can load directly into sharded HBM."""
+    specs = param_specs(cfg.is_moe)
+    if cfg.tie_embeddings:
+        specs.pop("lm_head", None)
+
+    def to_sharding(tree):
+        if isinstance(tree, dict):
+            return {k: to_sharding(v) for k, v in tree.items()}
+        return NamedSharding(mesh, tree)
+
+    return to_sharding(specs)
 
 
 def cache_shardings(mesh: Mesh, batch: int | None = None):

@@ -1,0 +1,156 @@
+"""Weight-only int8 quantization (fei_tpu.ops.quant).
+
+SURVEY.md §7 hard-part #4: the 70B-on-v5e path needs int8 weights. These
+tests pin the numerics (roundtrip error bound, matmul exactness of the
+scale factoring), the model-level parity (bf16 vs int8 logits), the decode
+path, and TP sharding of QTensor leaves on the CPU mesh.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fei_tpu.models.configs import get_model_config
+from fei_tpu.models.llama import KVCache, forward, init_params
+from fei_tpu.ops.quant import (
+    QTensor,
+    dequantize,
+    mm,
+    param_bytes,
+    quantize,
+    quantize_params,
+)
+
+
+class TestQuantize:
+    def test_roundtrip_error_bound(self):
+        w = jax.random.normal(jax.random.PRNGKey(0), (64, 32))
+        qt = quantize(w)
+        back = dequantize(qt, jnp.float32)
+        # symmetric int8: per-channel max error <= scale/2 = amax/254
+        amax = np.abs(np.asarray(w)).max(axis=0, keepdims=True)
+        assert np.all(np.abs(np.asarray(back) - np.asarray(w)) <= amax / 254 + 1e-7)
+
+    def test_zero_channel_safe(self):
+        w = jnp.zeros((8, 4))
+        qt = quantize(w)
+        assert not np.any(np.isnan(np.asarray(dequantize(qt, jnp.float32))))
+
+    def test_mm_matches_dequant_matmul_exactly(self):
+        """(x @ q) * s must equal x @ (q * s) — scale commutes."""
+        k = jax.random.split(jax.random.PRNGKey(1), 2)
+        x = jax.random.normal(k[0], (4, 64))
+        w = jax.random.normal(k[1], (64, 32))
+        qt = quantize(w)
+        got = mm(x, qt)
+        want = x @ dequantize(qt, jnp.float32)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-5, atol=1e-4
+        )
+
+    def test_mm_plain_array_passthrough(self):
+        k = jax.random.split(jax.random.PRNGKey(2), 2)
+        x = jax.random.normal(k[0], (4, 16))
+        w = jax.random.normal(k[1], (16, 8))
+        np.testing.assert_array_equal(np.asarray(mm(x, w)), np.asarray(x @ w))
+
+    def test_stacked_layer_scales(self):
+        """Stacked [L, in, out] weights quantize per-layer-per-channel."""
+        w = jax.random.normal(jax.random.PRNGKey(3), (3, 16, 8))
+        qt = quantize(w)
+        assert qt.q.shape == (3, 16, 8) and qt.s.shape == (3, 1, 8)
+        # each layer independently recoverable
+        for i in range(3):
+            lw = dequantize(QTensor(qt.q[i], qt.s[i]), jnp.float32)
+            np.testing.assert_allclose(
+                np.asarray(lw), np.asarray(w[i]), atol=float(jnp.abs(w[i]).max()) / 100
+            )
+
+
+class TestQuantizedModel:
+    def _params(self, cfg, dtype=jnp.float32):
+        return init_params(cfg, jax.random.PRNGKey(0), dtype=dtype)
+
+    def test_quantize_params_structure_and_bytes(self):
+        cfg = get_model_config("tiny")
+        params = self._params(cfg, jnp.bfloat16)
+        qparams = quantize_params(params)
+        assert isinstance(qparams["layers"]["wq"], QTensor)
+        assert qparams["layers"]["wq"].q.dtype == jnp.int8
+        assert not isinstance(qparams["layers"]["attn_norm"], QTensor)
+        assert not isinstance(qparams["embed"], QTensor)
+        # linear weights dominate tiny's layer bytes; expect a real shrink
+        assert param_bytes(qparams) < param_bytes(params)
+
+    def test_forward_parity(self):
+        """int8 logits track bf16 logits closely on a tiny model."""
+        cfg = get_model_config("tiny")
+        params = self._params(cfg)
+        qparams = quantize_params(params)
+        tokens = jnp.array([[1, 5, 9, 2]], jnp.int32)
+        cache = KVCache.create(cfg, 1, 16, jnp.float32)
+        want, _ = forward(params, cfg, tokens, cache)
+        got, _ = forward(qparams, cfg, tokens, cache)
+        err = np.abs(np.asarray(got) - np.asarray(want))
+        scale = np.abs(np.asarray(want)).max()
+        assert err.max() / scale < 0.03, f"relative logit err {err.max()/scale}"
+
+    def test_engine_int8_decode(self):
+        """End-to-end greedy decode with quantize="int8"."""
+        from fei_tpu.engine import GenerationConfig, InferenceEngine
+
+        eng = InferenceEngine.from_config(
+            "tiny", tokenizer="byte", quantize="int8", max_seq_len=64
+        )
+        assert isinstance(eng.params["layers"]["wq"], QTensor)
+        ids = eng.tokenizer.encode("hello", add_bos=True)
+        res = eng.generate(ids, GenerationConfig(max_new_tokens=6, temperature=0.0))
+        assert len(res.token_ids) == 6
+
+    def test_moe_quantized_forward(self):
+        cfg = get_model_config("tiny-moe")
+        params = self._params(cfg)
+        qparams = quantize_params(params)
+        assert isinstance(qparams["layers"]["w_gate"], QTensor)
+        assert not isinstance(qparams["layers"]["router"], QTensor)
+        tokens = jnp.array([[3, 1, 4, 1, 5, 9, 2, 6]], jnp.int32)
+        cache = KVCache.create(cfg, 1, 16, jnp.float32)
+        want, _ = forward(params, cfg, tokens, cache)
+        got, _ = forward(qparams, cfg, tokens, cache)
+        err = np.abs(np.asarray(got) - np.asarray(want))
+        scale = np.abs(np.asarray(want)).max()
+        assert err.max() / scale < 0.05
+
+
+class TestQuantizedSharding:
+    def test_tp_sharded_qtensor(self):
+        """QTensor leaves shard: int8 along the weight spec, scale along the
+        out dim only (contraction dim collapsed)."""
+        from fei_tpu.parallel.mesh import make_mesh
+        from fei_tpu.parallel.sharding import shard_params
+
+        if len(jax.devices()) < 2:
+            pytest.skip("needs multi-device mesh")
+        cfg = get_model_config("tiny")
+        mesh = make_mesh({"tp": 2}, devices=jax.devices()[:2])
+        params = quantize_params(
+            init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.bfloat16)
+        )
+        sharded = shard_params(params, mesh, cfg.is_moe)
+        wq = sharded["layers"]["wq"]
+        assert isinstance(wq, QTensor)
+        # column-split: out dim sharded on both q and s
+        assert "tp" in str(wq.q.sharding.spec)
+        assert "tp" in str(wq.s.sharding.spec)
+        # row-split wo: q shards contraction dim; s (contraction collapsed)
+        # must NOT try to shard its size-1 axis
+        wo = sharded["layers"]["wo"]
+        assert wo.s.shape[-2] == 1
+
+        tokens = jnp.array([[1, 2, 3]], jnp.int32)
+        cache = KVCache.create(cfg, 1, 8, jnp.bfloat16)
+        logits, _ = jax.jit(lambda p, t, c: forward(p, cfg, t, c))(
+            sharded, tokens, cache
+        )
+        assert logits.shape == (1, 3, cfg.vocab_size)

@@ -1,0 +1,211 @@
+"""Streamed sharded checkpoint loading (engine/weights.py).
+
+The 70B-on-a-pod path (SURVEY.md §7 hard-part #4): each device shard is
+read as a safetensors *slice* via jax.make_array_from_callback — the full
+stacked tensor must never be materialized on host. These tests verify the
+slice arithmetic (incl. the HF [out, in] -> ours [in, out] transpose),
+equality with the eager path, int8 quantize-on-read, and that per-shard
+reads really are partial.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fei_tpu.engine.weights import load_checkpoint
+from fei_tpu.models.configs import get_model_config
+from fei_tpu.models.llama import KVCache, forward
+from fei_tpu.ops.quant import QTensor, dequantize
+from fei_tpu.parallel.mesh import make_mesh
+from fei_tpu.parallel.sharding import param_shardings_from_cfg
+
+safetensors = pytest.importorskip("safetensors.numpy")
+
+
+def _write_hf_llama(tmp_path, cfg, seed=0):
+    base_rng = np.random.default_rng(seed)
+
+    class _Scaled:
+        # fan-in-ish scaling so the random model is numerically sane (an
+        # unscaled standard-normal stack amplifies int8 error multiplicatively)
+        def standard_normal(self, shape):
+            return base_rng.standard_normal(shape) * 0.05
+
+    rng = _Scaled()
+    h, d = cfg.hidden_size, cfg.head_dim_
+    H, K, I, L, V = (
+        cfg.num_heads, cfg.num_kv_heads, cfg.intermediate_size,
+        cfg.num_layers, cfg.vocab_size,
+    )
+    t = {
+        "model.embed_tokens.weight": rng.standard_normal((V, h)).astype(np.float32),
+        "model.norm.weight": np.ones(h, np.float32),
+        "lm_head.weight": rng.standard_normal((V, h)).astype(np.float32),
+    }
+    for i in range(L):
+        p = f"model.layers.{i}."
+        t[p + "input_layernorm.weight"] = np.ones(h, np.float32)
+        t[p + "post_attention_layernorm.weight"] = np.ones(h, np.float32)
+        t[p + "self_attn.q_proj.weight"] = rng.standard_normal((H * d, h)).astype(np.float32)
+        t[p + "self_attn.k_proj.weight"] = rng.standard_normal((K * d, h)).astype(np.float32)
+        t[p + "self_attn.v_proj.weight"] = rng.standard_normal((K * d, h)).astype(np.float32)
+        t[p + "self_attn.o_proj.weight"] = rng.standard_normal((h, H * d)).astype(np.float32)
+        if cfg.is_moe:
+            t[p + "block_sparse_moe.gate.weight"] = rng.standard_normal(
+                (cfg.num_experts, h)
+            ).astype(np.float32)
+            for e in range(cfg.num_experts):
+                q = p + f"block_sparse_moe.experts.{e}."
+                t[q + "w1.weight"] = rng.standard_normal((I, h)).astype(np.float32)
+                t[q + "w2.weight"] = rng.standard_normal((h, I)).astype(np.float32)
+                t[q + "w3.weight"] = rng.standard_normal((I, h)).astype(np.float32)
+        else:
+            t[p + "mlp.gate_proj.weight"] = rng.standard_normal((I, h)).astype(np.float32)
+            t[p + "mlp.up_proj.weight"] = rng.standard_normal((I, h)).astype(np.float32)
+            t[p + "mlp.down_proj.weight"] = rng.standard_normal((h, I)).astype(np.float32)
+    safetensors.save_file(t, str(tmp_path / "model.safetensors"))
+    (tmp_path / "config.json").write_text(json.dumps({"vocab_size": cfg.vocab_size}))
+    return t
+
+
+def _trees_equal(a, b):
+    fa, fb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(fa) == len(fb)
+    for x, y in zip(fa, fb):
+        np.testing.assert_array_equal(
+            np.asarray(x, np.float32), np.asarray(y, np.float32)
+        )
+
+
+class TestStreamedLoad:
+    def test_streamed_equals_eager(self, tmp_path):
+        cfg = get_model_config("tiny")
+        _write_hf_llama(tmp_path, cfg)
+        mesh = make_mesh({"tp": 2, "dp": 4})
+        _, eager = load_checkpoint(str(tmp_path), cfg, dtype=jnp.float32)
+        cfg2, streamed = load_checkpoint(
+            str(tmp_path), cfg, dtype=jnp.float32,
+            shardings=param_shardings_from_cfg(cfg, mesh),
+        )
+        _trees_equal(eager, streamed)
+        # really sharded: wq's out dim split over tp
+        assert "tp" in str(streamed["layers"]["wq"].sharding.spec)
+
+        tokens = jnp.array([[1, 2, 3, 4]], jnp.int32)
+        cache = KVCache.create(cfg2, 1, 8, jnp.float32)
+        logits, _ = forward(streamed, cfg2, tokens, cache)
+        want, _ = forward(eager, cfg2, tokens, cache)
+        np.testing.assert_allclose(
+            np.asarray(logits), np.asarray(want), atol=2e-4
+        )
+
+    def test_streamed_moe(self, tmp_path):
+        cfg = get_model_config("tiny-moe")
+        _write_hf_llama(tmp_path, cfg)
+        mesh = make_mesh({"ep": 2, "tp": 2, "dp": 2})
+        _, eager = load_checkpoint(str(tmp_path), cfg, dtype=jnp.float32)
+        _, streamed = load_checkpoint(
+            str(tmp_path), cfg, dtype=jnp.float32,
+            shardings=param_shardings_from_cfg(cfg, mesh),
+        )
+        _trees_equal(eager, streamed)
+        assert "ep" in str(streamed["layers"]["w_gate"].sharding.spec)
+
+    def test_partial_reads_only(self, tmp_path, monkeypatch):
+        """Sharded loads must read slices, not whole tensors: spy on the
+        reader and assert no wq read spans the full out dim on a tp-split
+        mesh (each of 2 shards should ask for half the columns)."""
+        from fei_tpu.engine import weights as W
+
+        cfg = get_model_config("tiny")
+        _write_hf_llama(tmp_path, cfg)
+        mesh = make_mesh({"tp": 2, "dp": 4})
+        seen = []
+        orig = W._ShardReader.read
+
+        def spy(self, name, idx, transpose, expect_hf=None):
+            seen.append((name, idx))
+            return orig(self, name, idx, transpose, expect_hf)
+
+        monkeypatch.setattr(W._ShardReader, "read", spy)
+        load_checkpoint(
+            str(tmp_path), cfg, dtype=jnp.float32,
+            shardings=param_shardings_from_cfg(cfg, mesh),
+        )
+        out_dim = cfg.num_heads * cfg.head_dim_
+        wq_reads = [
+            idx for name, idx in seen if "q_proj" in name
+        ]
+        assert wq_reads, "no q_proj slice reads recorded"
+        for idx in wq_reads:
+            cols = idx[-1]
+            assert (cols.stop - (cols.start or 0)) <= out_dim // 2
+
+    def test_streamed_int8(self, tmp_path):
+        """Quantize-on-read: QTensor leaves, sharded, matching host-side
+        quantization of the eager weights."""
+        from fei_tpu.ops.quant import quantize_params
+
+        cfg = get_model_config("tiny")
+        _write_hf_llama(tmp_path, cfg)
+        mesh = make_mesh({"tp": 2, "dp": 4})
+        _, eager = load_checkpoint(str(tmp_path), cfg, dtype=jnp.float32)
+        cfg2, qstreamed = load_checkpoint(
+            str(tmp_path), cfg, dtype=jnp.float32,
+            shardings=param_shardings_from_cfg(cfg, mesh),
+            quantize="int8",
+        )
+        wq = qstreamed["layers"]["wq"]
+        assert isinstance(wq, QTensor) and wq.q.dtype == jnp.int8
+        qeager = quantize_params(eager)
+        # row-parallel wo: scales must be *global* over the sharded
+        # contraction dim — identical to the unsharded quantization
+        np.testing.assert_allclose(
+            np.asarray(qstreamed["layers"]["wo"].s),
+            np.asarray(qeager["layers"]["wo"].s), rtol=1e-6,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(qstreamed["layers"]["wo"].q),
+            np.asarray(qeager["layers"]["wo"].q),
+        )
+        # and the quantized model still runs sharded
+        tokens = jnp.array([[5, 6, 7]], jnp.int32)
+        cache = KVCache.create(cfg2, 1, 8, jnp.float32)
+        logits, _ = forward(qstreamed, cfg2, tokens, cache)
+        want, _ = forward(eager, cfg2, tokens, cache)
+        rel = np.abs(np.asarray(logits) - np.asarray(want)).max()
+        rel /= np.abs(np.asarray(want)).max()
+        assert rel < 0.03
+
+    def test_shape_mismatch_fails_loudly(self, tmp_path):
+        """A config smaller than the checkpoint must error, not silently
+        truncate via slice reads."""
+        from fei_tpu.utils.errors import CheckpointError
+
+        cfg = get_model_config("tiny")
+        _write_hf_llama(tmp_path, cfg)
+        (tmp_path / "config.json").unlink()  # nothing to self-correct from
+        from dataclasses import replace
+
+        wrong = replace(cfg, intermediate_size=cfg.intermediate_size // 2)
+        with pytest.raises(CheckpointError, match="shape"):
+            load_checkpoint(str(tmp_path), wrong, dtype=jnp.float32)
+
+    def test_engine_from_config_streams(self, tmp_path):
+        from fei_tpu.engine import GenerationConfig, InferenceEngine
+
+        cfg = get_model_config("tiny")
+        _write_hf_llama(tmp_path, cfg)
+        mesh = make_mesh({"tp": 2, "dp": 4})
+        eng = InferenceEngine.from_config(
+            "tiny", tokenizer="byte", checkpoint_dir=str(tmp_path),
+            mesh=mesh, quantize="int8", max_seq_len=64, dtype=jnp.float32,
+        )
+        assert isinstance(eng.params["layers"]["wq"], QTensor)
+        assert eng.mesh is mesh
+        ids = eng.tokenizer.encode("hi", add_bos=True)
+        res = eng.generate(ids, GenerationConfig(max_new_tokens=4, temperature=0.0))
+        assert len(res.token_ids) == 4
