@@ -137,13 +137,6 @@ class _ShardReader:
             return ts[idx[0]]
         return ts[idx]
 
-    def get(self, name: str) -> np.ndarray:
-        """Whole-tensor read (eager path)."""
-        if name not in self.map:
-            raise CheckpointError(f"tensor {name!r} missing from checkpoint")
-        return self._file(self.map[name]).get_tensor(name)
-
-
 def _full(shape: tuple) -> tuple:
     return tuple(slice(0, s) for s in shape)
 

@@ -23,7 +23,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-from fei_tpu.ops.quant import dequantize, mm
+from fei_tpu.ops.quant import mm
 from fei_tpu.models.configs import ModelConfig
 from fei_tpu.ops.attention import attention
 from fei_tpu.ops.moe import moe_mlp, moe_mlp_routed
@@ -104,13 +104,11 @@ def _moe(cfg: ModelConfig, y, lp, allow_routed: bool, moe_mesh=None):
     caller allows it and the token count amortizes the sort. Expert FLOPs
     drop to k/E of dense when routed."""
     mode = os.environ.get("FEI_TPU_ROUTED_MOE", "auto")
-    # int8 expert weights are dequantized per-layer here (one layer's experts
-    # at a time inside the scan; XLA fuses the convert into the expert GEMMs)
+    # int8 expert weights pass through as QTensor: every MoE formulation
+    # streams the int8 and applies scales to einsum/ragged_dot results
+    # (ops.quant.scale_expert_out/scale_rows) — no dense bf16 copy
     args = (
-        y, lp["router"],
-        dequantize(lp["w_gate"], y.dtype),
-        dequantize(lp["w_up"], y.dtype),
-        dequantize(lp["w_down"], y.dtype),
+        y, lp["router"], lp["w_gate"], lp["w_up"], lp["w_down"],
         cfg.num_experts_per_tok,
     )
     if (

@@ -13,6 +13,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from fei_tpu.ops.quant import scale_expert_out, scale_rows, wcast
+
 
 def moe_mlp(
     x: jnp.ndarray,  # [B, T, H]
@@ -31,11 +33,19 @@ def moe_mlp(
     one_hot = jax.nn.one_hot(topk_idx, E, dtype=jnp.float32)  # [B,T,k,E]
     weights = jnp.einsum("btk,btke->bte", topk_weights, one_hot)
 
-    # every expert runs on every token; weights gate the combination
-    gate = jnp.einsum("bth,ehi->beti", x, w_gate)
-    up = jnp.einsum("bth,ehi->beti", x, w_up)
+    # every expert runs on every token; weights gate the combination.
+    # int8 experts: einsum the raw int8 (cast) and scale the result before
+    # the nonlinearity — no dense bf16 weight copy is ever materialized
+    gate = scale_expert_out(
+        jnp.einsum("bth,ehi->beti", x, wcast(w_gate, x.dtype)), w_gate, 1
+    )
+    up = scale_expert_out(
+        jnp.einsum("bth,ehi->beti", x, wcast(w_up, x.dtype)), w_up, 1
+    )
     act = jax.nn.silu(gate.astype(jnp.float32)).astype(x.dtype) * up
-    expert_out = jnp.einsum("beti,eih->beth", act, w_down)  # [B,E,T,H]
+    expert_out = scale_expert_out(
+        jnp.einsum("beti,eih->beth", act, wcast(w_down, act.dtype)), w_down, 1
+    )  # [B,E,T,H]
     out = jnp.einsum("bte,beth->bth", weights.astype(x.dtype), expert_out)
     return out
 
@@ -75,12 +85,22 @@ def moe_mlp_routed(
     order = jnp.argsort(flat_expert)  # stable: ties keep token order
     token_of = order // k  # source token of each sorted assignment
     xs = jnp.take(xf, token_of, axis=0)  # [N*k, H]
+    expert_of = jnp.take(flat_expert, order)  # expert of each sorted row
     group_sizes = jnp.bincount(flat_expert, length=E).astype(jnp.int32)
 
-    gate = jax.lax.ragged_dot(xs, w_gate, group_sizes)
-    up = jax.lax.ragged_dot(xs, w_up, group_sizes)
+    gate = scale_rows(
+        jax.lax.ragged_dot(xs, wcast(w_gate, xs.dtype), group_sizes),
+        w_gate, expert_of,
+    )
+    up = scale_rows(
+        jax.lax.ragged_dot(xs, wcast(w_up, xs.dtype), group_sizes),
+        w_up, expert_of,
+    )
     act = jax.nn.silu(gate.astype(jnp.float32)).astype(x.dtype) * up
-    outs = jax.lax.ragged_dot(act, w_down, group_sizes)  # [N*k, H]
+    outs = scale_rows(
+        jax.lax.ragged_dot(act, wcast(w_down, act.dtype), group_sizes),
+        w_down, expert_of,
+    )  # [N*k, H]
 
     wf = jnp.take(topk_weights.reshape(-1), order).astype(x.dtype)
     out = jnp.zeros((N, H), dtype=x.dtype).at[token_of].add(outs * wf[:, None])

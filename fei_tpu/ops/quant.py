@@ -83,6 +83,38 @@ def mm(x: jnp.ndarray, w) -> jnp.ndarray:
     return x @ w
 
 
+def wcast(w, dtype) -> jnp.ndarray:
+    """The raw weight for an einsum/ragged_dot operand: int8 cast to the
+    compute dtype for QTensor (scale applied separately to the result via
+    scale_expert_out / scale_rows), passthrough otherwise."""
+    if isinstance(w, QTensor):
+        return w.q.astype(dtype)
+    return w
+
+
+def scale_expert_out(out: jnp.ndarray, w, expert_axis: int) -> jnp.ndarray:
+    """Apply a stacked-expert QTensor scale ([E, 1, out]) to an einsum
+    result whose last axis is the out dim and ``expert_axis`` indexes
+    experts. Exact (scale is constant along the contraction); no-op for
+    plain arrays. Must run BEFORE any nonlinearity."""
+    if not isinstance(w, QTensor):
+        return out
+    s = jnp.squeeze(w.s, axis=-2)  # [E, out]
+    shape = [1] * out.ndim
+    shape[expert_axis] = s.shape[0]
+    shape[-1] = s.shape[1]
+    return out * s.reshape(shape).astype(out.dtype)
+
+
+def scale_rows(out: jnp.ndarray, w, expert_ids: jnp.ndarray) -> jnp.ndarray:
+    """Per-row scale for grouped-GEMM (ragged_dot) results: row i belongs
+    to expert ``expert_ids[i]``, so it picks that expert's [out] scale."""
+    if not isinstance(w, QTensor):
+        return out
+    s = jnp.squeeze(w.s, axis=-2)  # [E, out]
+    return out * jnp.take(s, expert_ids, axis=0).astype(out.dtype)
+
+
 def quantize_params(params: dict) -> dict:
     """Quantize the big linear weights of a stacked param pytree in place
     of their bf16 leaves. Norms/router/embed are left untouched."""

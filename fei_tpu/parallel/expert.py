@@ -26,6 +26,21 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from fei_tpu.ops.quant import QTensor, scale_expert_out, wcast
+
+
+def _wspec(w, spec: P):
+    """shard_map in_spec for a possibly-quantized expert weight: QTensor
+    scales replace spec entries with None where their dim collapsed to 1
+    (the contraction axis), mirroring parallel.sharding._scale_spec."""
+    if not isinstance(w, QTensor):
+        return spec
+    entries = list(spec) + [None] * (w.s.ndim - len(spec))
+    s_spec = P(*[
+        None if w.s.shape[i] == 1 else entries[i] for i in range(w.s.ndim)
+    ])
+    return QTensor(q=spec, s=s_spec)
+
 
 def _moe_shard(x, router_w, w_gate, w_up, w_down, *, k: int, axis_name: str):
     """Per-device body: local experts only (runs under shard_map).
@@ -49,10 +64,16 @@ def _moe_shard(x, router_w, w_gate, w_up, w_down, *, k: int, axis_name: str):
     # this device's slice of the routing weights
     local_weights = jax.lax.dynamic_slice_in_dim(weights, offset, E_local, axis=2)
 
-    gate = jnp.einsum("bth,ehi->beti", x, w_gate)
-    up = jnp.einsum("bth,ehi->beti", x, w_up)
+    gate = scale_expert_out(
+        jnp.einsum("bth,ehi->beti", x, wcast(w_gate, x.dtype)), w_gate, 1
+    )
+    up = scale_expert_out(
+        jnp.einsum("bth,ehi->beti", x, wcast(w_up, x.dtype)), w_up, 1
+    )
     act = jax.nn.silu(gate.astype(jnp.float32)).astype(x.dtype) * up
-    expert_out = jnp.einsum("beti,eih->beth", act, w_down)  # [B,E_local,T,H]
+    expert_out = scale_expert_out(
+        jnp.einsum("beti,eih->beth", act, wcast(w_down, act.dtype)), w_down, 1
+    )  # [B,E_local,T,H]
     partial = jnp.einsum(
         "bte,beth->bth", local_weights.astype(x.dtype), expert_out
     )
@@ -81,12 +102,16 @@ def moe_mlp_ep(
         raise ValueError(
             f"ep axis size {n} must divide num_experts {E} evenly"
         )
+    espec = P(axis_name)
     fn = jax.shard_map(
         functools.partial(
             _moe_shard, k=num_experts_per_tok, axis_name=axis_name
         ),
         mesh=mesh,
-        in_specs=(P(), P(), P(axis_name), P(axis_name), P(axis_name)),
+        in_specs=(
+            P(), P(),
+            _wspec(w_gate, espec), _wspec(w_up, espec), _wspec(w_down, espec),
+        ),
         out_specs=P(),
     )
     return fn(x, router_w, w_gate, w_up, w_down)
@@ -143,10 +168,16 @@ def _routed_shard(
     recv = jax.lax.all_to_all(
         dispatched, axis_name, split_axis=0, concat_axis=1, tiled=True
     )  # [E_local, n*C, H]
-    gate = jnp.einsum("ech,ehi->eci", recv, w_gate)
-    up = jnp.einsum("ech,ehi->eci", recv, w_up)
+    gate = scale_expert_out(
+        jnp.einsum("ech,ehi->eci", recv, wcast(w_gate, recv.dtype)), w_gate, 0
+    )
+    up = scale_expert_out(
+        jnp.einsum("ech,ehi->eci", recv, wcast(w_up, recv.dtype)), w_up, 0
+    )
     act = jax.nn.silu(gate.astype(jnp.float32)).astype(recv.dtype) * up
-    expert_out = jnp.einsum("eci,eih->ech", act, w_down)  # [E_local, n*C, H]
+    expert_out = scale_expert_out(
+        jnp.einsum("eci,eih->ech", act, wcast(w_down, act.dtype)), w_down, 0
+    )  # [E_local, n*C, H]
     if tp_axis is not None:
         # experts' I dimension is tp-sharded (Megatron column/row split);
         # one psum completes each expert's down-projection
@@ -213,7 +244,12 @@ def moe_mlp_ep_routed(
             tp_axis=tp_axis,
         ),
         mesh=mesh,
-        in_specs=(P(), P(), wspec_up, wspec_up, wspec_down),
+        in_specs=(
+            P(), P(),
+            _wspec(w_gate, wspec_up),
+            _wspec(w_up, wspec_up),
+            _wspec(w_down, wspec_down),
+        ),
         out_specs=P(),
         # the final all_gather makes the output replicated, but the varying-
         # axes checker can't prove it through the axis_index-dependent slice

@@ -29,7 +29,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from fei_tpu.models.configs import ModelConfig
 from fei_tpu.models.llama import KVCache, _logits
 from fei_tpu.ops.moe import moe_mlp
-from fei_tpu.ops.quant import dequantize, mm
+from fei_tpu.ops.quant import mm
 from fei_tpu.ops.rmsnorm import rms_norm
 from fei_tpu.ops.rope import apply_rope, compute_rope_freqs
 from fei_tpu.parallel.ring import _ring_attention_shard
@@ -63,10 +63,7 @@ def _prefill_shard(x, layers, cos, sin, *, cfg: ModelConfig, axis_name: str):
         y = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
         if cfg.is_moe:
             mlp_out = moe_mlp(
-                y, lp["router"],
-                dequantize(lp["w_gate"], y.dtype),
-                dequantize(lp["w_up"], y.dtype),
-                dequantize(lp["w_down"], y.dtype),
+                y, lp["router"], lp["w_gate"], lp["w_up"], lp["w_down"],
                 cfg.num_experts_per_tok,
             )
         else:

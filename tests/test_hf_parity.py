@@ -1,0 +1,130 @@
+"""Golden-token parity against HuggingFace transformers (installed in-image).
+
+VERDICT r1 weak-spot #7: nothing previously compared our stacked-pytree
+forward against a reference implementation, so a silent RoPE/GQA layout bug
+could pass every hermetic test. Here a tiny random HF LlamaForCausalLM is
+save_pretrained'd, loaded through engine/weights.load_checkpoint, and the
+logits must agree to fp32 tolerance; the chat-template ids must be identical
+between our HFTokenizer wrapper and transformers' own apply_chat_template.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+transformers = pytest.importorskip("transformers")
+torch = pytest.importorskip("torch")
+
+from fei_tpu.engine.weights import load_checkpoint
+from fei_tpu.models.configs import get_model_config
+from fei_tpu.models.llama import KVCache, forward
+
+
+def _tiny_hf_llama(tmp_path, tie_embeddings=False):
+    cfg = transformers.LlamaConfig(
+        vocab_size=256,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=3,
+        num_attention_heads=4,
+        num_key_value_heads=2,  # GQA: the layout bug this test exists for
+        max_position_embeddings=128,
+        rope_theta=10000.0,
+        rms_norm_eps=1e-5,
+        tie_word_embeddings=tie_embeddings,
+    )
+    torch.manual_seed(0)
+    model = transformers.LlamaForCausalLM(cfg).eval()
+    model.save_pretrained(str(tmp_path), safe_serialization=True)
+    return model, cfg
+
+
+class TestHFLogitParity:
+    @pytest.mark.parametrize("tie", [False, True])
+    def test_logits_match(self, tmp_path, tie):
+        model, hf_cfg = _tiny_hf_llama(tmp_path, tie_embeddings=tie)
+
+        ids = np.array([[1, 7, 42, 99, 3, 250, 17, 5]], dtype=np.int64)
+        with torch.no_grad():
+            want = model(torch.from_numpy(ids)).logits.float().numpy()
+
+        cfg = get_model_config("tiny")  # every field overridden by config.json
+        cfg2, params = load_checkpoint(str(tmp_path), cfg, dtype=jnp.float32)
+        assert cfg2.num_kv_heads == 2 and cfg2.tie_embeddings == tie
+
+        cache = KVCache.create(cfg2, 1, ids.shape[1], jnp.float32)
+        got, _ = forward(params, cfg2, jnp.asarray(ids, jnp.int32), cache)
+
+        np.testing.assert_allclose(np.asarray(got)[0], want[0], atol=1e-3)
+
+    def test_decode_matches_prefill_split(self, tmp_path):
+        """Prefill 5 tokens then decode 3 one-by-one == one 8-token prefill
+        (exercises the cache write path against HF-derived weights)."""
+        model, _ = _tiny_hf_llama(tmp_path)
+        cfg = get_model_config("tiny")
+        cfg2, params = load_checkpoint(str(tmp_path), cfg, dtype=jnp.float32)
+
+        ids = jnp.array([[1, 7, 42, 99, 3, 250, 17, 5]], jnp.int32)
+        cache_full = KVCache.create(cfg2, 1, 8, jnp.float32)
+        want, _ = forward(params, cfg2, ids, cache_full)
+
+        cache = KVCache.create(cfg2, 1, 8, jnp.float32)
+        _, cache = forward(params, cfg2, ids[:, :5], cache)
+        outs = []
+        for t in range(5, 8):
+            logits, cache = forward(params, cfg2, ids[:, t : t + 1], cache)
+            outs.append(np.asarray(logits)[0, 0])
+        np.testing.assert_allclose(
+            np.stack(outs), np.asarray(want)[0, 5:], atol=1e-3
+        )
+
+    def test_int8_tracks_hf(self, tmp_path):
+        """Quantized load stays within int8 error of the HF reference."""
+        model, _ = _tiny_hf_llama(tmp_path)
+        ids = np.array([[1, 7, 42, 99]], dtype=np.int64)
+        with torch.no_grad():
+            want = model(torch.from_numpy(ids)).logits.float().numpy()
+
+        cfg = get_model_config("tiny")
+        cfg2, params = load_checkpoint(
+            str(tmp_path), cfg, dtype=jnp.float32, quantize="int8"
+        )
+        cache = KVCache.create(cfg2, 1, 4, jnp.float32)
+        got, _ = forward(params, cfg2, jnp.asarray(ids, jnp.int32), cache)
+        rel = np.abs(np.asarray(got)[0] - want[0]).max() / np.abs(want[0]).max()
+        assert rel < 0.05, f"int8 relative error vs HF: {rel}"
+
+
+class TestChatTemplateParity:
+    def test_template_ids_identical(self, tmp_path):
+        """Our HFTokenizer.apply_chat_template must produce byte-identical
+        ids to transformers' own (same template, same specials)."""
+        # zero egress: build a local tokenizer + template instead of a hub one
+        pytest.importorskip("tokenizers")
+        from tokenizers import Tokenizer, models, pre_tokenizers
+
+        vocab = {chr(i) if 32 <= i < 127 else f"<0x{i:02X}>": i for i in range(256)}
+        vocab["<|bos|>"] = 256
+        vocab["<|eot|>"] = 257
+        t = Tokenizer(models.WordLevel(vocab, unk_token="<0x00>"))
+        t.pre_tokenizer = pre_tokenizers.Split("", "isolated")
+        fast = transformers.PreTrainedTokenizerFast(
+            tokenizer_object=t, bos_token="<|bos|>", eos_token="<|eot|>"
+        )
+        fast.chat_template = (
+            "{{ bos_token }}{% for m in messages %}"
+            "[{{ m.role }}]{{ m.content }}{{ eos_token }}{% endfor %}"
+            "{% if add_generation_prompt %}[assistant]{% endif %}"
+        )
+        fast.save_pretrained(str(tmp_path))
+
+        from fei_tpu.engine.tokenizer import HFTokenizer
+
+        ours = HFTokenizer(str(tmp_path))
+        msgs = [
+            {"role": "system", "content": "be brief"},
+            {"role": "user", "content": "hi there"},
+        ]
+        want = fast.apply_chat_template(msgs, add_generation_prompt=True)
+        got = ours.apply_chat_template(msgs, add_generation_prompt=True)
+        assert list(got) == list(want)

@@ -123,6 +123,56 @@ class TestQuantizedModel:
         assert err.max() / scale < 0.05
 
 
+class TestQuantizedMoEPaths:
+    """int8 experts must flow through every MoE formulation without a dense
+    bf16 weight copy (result-side scaling via scale_expert_out/scale_rows)."""
+
+    def _weights(self, E=4, H=16, I=32, key=0):
+        ks = jax.random.split(jax.random.PRNGKey(key), 5)
+        r = lambda k, s: jax.random.normal(k, s) * 0.3
+        return (
+            r(ks[0], (H, E)),  # router
+            r(ks[1], (E, H, I)), r(ks[2], (E, H, I)), r(ks[3], (E, I, H)),
+            r(ks[4], (2, 6, H)),  # x
+        )
+
+    def test_routed_matches_dense_quantized(self):
+        from fei_tpu.ops.moe import moe_mlp, moe_mlp_routed
+
+        router, wg, wu, wd, x = self._weights()
+        qg, qu, qd = quantize(wg), quantize(wu), quantize(wd)
+        want = moe_mlp(x, router, qg, qu, qd, 2)
+        got = moe_mlp_routed(x, router, qg, qu, qd, 2)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), atol=2e-5
+        )
+        # and quantized dense tracks the fp32 dense closely
+        ref = moe_mlp(x, router, wg, wu, wd, 2)
+        assert np.abs(np.asarray(want) - np.asarray(ref)).max() < 0.05
+
+    def test_ep_routed_quantized(self):
+        from fei_tpu.ops.moe import moe_mlp
+        from fei_tpu.parallel.expert import moe_mlp_ep, moe_mlp_ep_routed
+        from fei_tpu.parallel.mesh import make_mesh
+
+        if len(jax.devices()) < 4:
+            pytest.skip("needs 4-device mesh")
+        router, wg, wu, wd, x = self._weights()
+        qg, qu, qd = quantize(wg), quantize(wu), quantize(wd)
+        mesh = make_mesh({"ep": 4, "tp": 2}, devices=jax.devices()[:8])
+        want = moe_mlp(x, router, qg, qu, qd, 2)
+        got_dense = moe_mlp_ep(x, router, qg, qu, qd, 2, mesh)
+        got_routed = moe_mlp_ep_routed(
+            x, router, qg, qu, qd, 2, mesh, dropless=True, tp_axis="tp"
+        )
+        np.testing.assert_allclose(
+            np.asarray(got_dense), np.asarray(want), atol=2e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(got_routed), np.asarray(want), atol=2e-5
+        )
+
+
 class TestQuantizedSharding:
     def test_tp_sharded_qtensor(self):
         """QTensor leaves shard: int8 along the weight spec, scale along the
