@@ -275,9 +275,11 @@ class InferenceEngine:
                         logits, sub,
                         temperature=temperature, top_k=top_k, top_p=top_p,
                     )
+                    # table may be int16 (128k-vocab grammars halve their
+                    # bytes); the carry state stays int32
                     gstate = jnp.take_along_axis(
                         row, nxt[:, None], axis=1
-                    )[:, 0]
+                    )[:, 0].astype(jnp.int32)
                     return (
                         cache, nxt[:, None], rng, gstate, remaining - 1
                     ), nxt

@@ -313,62 +313,91 @@ class JsonSchemaGrammar:
         return entry
 
 
-def _token_text(tokenizer, tid: int) -> str | None:
-    """A token's *in-context* text.
+def _all_token_texts(tokenizer) -> list[str | None]:
+    """Every token's *in-context* text, in one pass.
 
     ``decode([tid])`` alone is wrong for sentencepiece/BPE vocabs: a token
     whose true text is " true" decodes standalone as "true", so the DFA
     would validate different bytes than the detokenizer later emits. For HF
     tokenizers we decode behind an anchor token and take the suffix, which
-    preserves leading spaces exactly as they will appear in real output.
+    preserves leading spaces exactly as they will appear in real output —
+    and we do it with ONE ``batch_decode`` call (the per-token Python loop
+    is what made 128k-vocab lifts cost minutes).
     """
+    V = tokenizer.vocab_size
     hf = getattr(tokenizer, "_tok", None)
-    try:
-        if hf is None:
-            return tokenizer.decode([tid]) or None
-        anchor = hf.encode(":", add_special_tokens=False)
-        if not anchor:
-            return hf.decode([tid], skip_special_tokens=True) or None
-        base = hf.decode([anchor[0]], skip_special_tokens=True)
-        ctx = hf.decode([anchor[0], tid], skip_special_tokens=True)
-        if ctx.startswith(base):
-            return ctx[len(base):] or None
-        return hf.decode([tid], skip_special_tokens=True) or None
-    except Exception:
-        return None
+    if hf is None:
+        out = []
+        for tid in range(V):
+            try:
+                out.append(tokenizer.decode([tid]) or None)
+            except Exception:
+                out.append(None)
+        return out
+    anchor = hf.encode(":", add_special_tokens=False)
+    if not anchor:
+        texts = hf.batch_decode(
+            [[tid] for tid in range(V)], skip_special_tokens=True
+        )
+        return [t or None for t in texts]
+    a = anchor[0]
+    base = hf.decode([a], skip_special_tokens=True)
+    ctx = hf.batch_decode([[a, tid] for tid in range(V)], skip_special_tokens=True)
+    solo = hf.batch_decode([[tid] for tid in range(V)], skip_special_tokens=True)
+    return [
+        (c[len(base):] if c.startswith(base) else s) or None
+        for c, s in zip(ctx, solo)
+    ]
 
 
 class TokenGrammar:
     """Token-level lift of a JsonSchemaGrammar for a concrete tokenizer.
 
-    Builds [n_states, vocab] transition (int32, -1 = forbidden) and mask
-    (bool) tables. Works with any tokenizer exposing ``decode([id])``;
-    multi-byte tokens walk the char DFA transitively.
+    Builds [n_states, vocab] transition (-1 = forbidden) and mask (bool)
+    tables. Works with any tokenizer exposing ``decode([id])``; multi-byte
+    tokens walk the char DFA transitively. The walk is vectorized over the
+    whole (state × token) grid — one gather per byte position — so lifting
+    a ~200-state tool grammar through a 128k vocab takes seconds, not
+    minutes, and the table stores int16 when the state count fits (halving
+    host and device bytes at Llama-3 vocab scale; ~50 MB for 200 states).
+    ``lift_seconds`` / ``table_bytes`` record the measured cost.
     """
 
     def __init__(self, grammar: JsonSchemaGrammar, tokenizer):
+        import time
+
+        t0 = time.perf_counter()
         self.grammar = grammar
         self.tokenizer = tokenizer
         char_tab = grammar.char_table
         n_states = char_tab.shape[0]
         V = tokenizer.vocab_size
-        table = np.full((n_states, V), -1, dtype=np.int32)
 
-        token_bytes: list[bytes | None] = []
-        for tid in range(V):
-            text = _token_text(tokenizer, tid)
-            bs = text.encode("utf-8") if text else b""
-            token_bytes.append(bs if bs else None)
-
+        texts = _all_token_texts(tokenizer)
+        token_bytes = [
+            t.encode("utf-8") if t else b"" for t in texts
+        ]
+        max_len = max((len(b) for b in token_bytes), default=0)
+        # [V, max_len] byte matrix, -1 padded
+        byte_mat = np.full((V, max_len), -1, dtype=np.int16)
         for tid, bs in enumerate(token_bytes):
-            if bs is None:
-                continue
-            # vectorized walk over start states
-            states = np.arange(n_states, dtype=np.int32)
-            for b in bs:
-                valid = states >= 0
-                states = np.where(valid, char_tab[np.maximum(states, 0), b], -1)
-            table[:, tid] = states
+            if bs:
+                byte_mat[tid, : len(bs)] = np.frombuffer(bs, dtype=np.uint8)
+
+        # walk all (state, token) pairs one byte position at a time
+        S = np.broadcast_to(
+            np.arange(n_states, dtype=np.int32)[:, None], (n_states, V)
+        ).copy()
+        for pos in range(max_len):
+            b = byte_mat[:, pos].astype(np.int32)  # [V]
+            has = (b >= 0)[None, :]
+            nxt = char_tab[np.maximum(S, 0), np.maximum(b, 0)[None, :]]
+            S = np.where(has & (S >= 0), nxt, np.where(has, -1, S))
+        # tokens with no text (specials, undecodables) are never legal
+        empty = np.array([not bs for bs in token_bytes])
+        S[:, empty] = -1
+        dtype = np.int16 if n_states < np.iinfo(np.int16).max else np.int32
+        table = S.astype(dtype)
 
         # stop tokens are allowed in every *accepting* state: the accept
         # state itself plus any state whose also-fallback chain reaches it
@@ -393,6 +422,8 @@ class TokenGrammar:
         self.entry = grammar.entry
         self.accept = grammar.accept
         self.min_dist = self._min_distances()
+        self.lift_seconds = time.perf_counter() - t0
+        self.table_bytes = self.table.nbytes
 
     def _min_distances(self) -> np.ndarray:
         """min_dist[s] = fewest tokens from state s to the accept state.
@@ -427,7 +458,8 @@ class TokenGrammar:
         table = self.table
         if vocab_size is not None and vocab_size > table.shape[1]:
             pad = np.full(
-                (table.shape[0], vocab_size - table.shape[1]), -1, dtype=np.int32
+                (table.shape[0], vocab_size - table.shape[1]), -1,
+                dtype=table.dtype,
             )
             table = np.concatenate([table, pad], axis=1)
         return jnp.asarray(table), jnp.asarray(self.min_dist)

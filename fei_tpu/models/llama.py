@@ -137,19 +137,18 @@ def _moe(cfg: ModelConfig, y, lp, allow_routed: bool, moe_mesh=None):
     return fn(*args)
 
 
-def _attend(q, k, v, kv_length, positions, allow_flash=True):
+def _attend(q, k, v, kv_length, positions):
     """Pick the attention path at trace time.
 
     FEI_TPU_FLASH=1 forces the Pallas flash kernel (interpret mode off-TPU,
     for tests), =0 forces the XLA oracle; default "auto" uses flash for
     TPU prefill-sized T. ``kv_length`` is the pre-write cache length [B];
-    keys are valid below kv_length + T. ``allow_flash=False`` is the
-    training path: the kernel has no custom VJP yet, so differentiating
-    through it would fail — training stays on the XLA oracle.
+    keys are valid below kv_length + T. The kernel has a Pallas flash
+    backward (custom_vjp, recompute) so the training path uses it too.
     """
     T = q.shape[1]
     mode = os.environ.get("FEI_TPU_FLASH", "auto")
-    use_flash = allow_flash and (
+    use_flash = (
         mode == "1"
         or (mode == "auto" and T >= _FLASH_MIN_T and jax.default_backend() == "tpu")
     )
@@ -188,9 +187,7 @@ def _layer(
         new_k = jax.vmap(write)(cache_k, k, kv_length)
         new_v = jax.vmap(write)(cache_v, v, kv_length)
 
-    attn_out = _attend(
-        q, new_k, new_v, kv_length, positions, allow_flash=cache_k is not None
-    )
+    attn_out = _attend(q, new_k, new_v, kv_length, positions)
     x = x + mm(attn_out.reshape(B, T, Hq * d), lp["wo"])
 
     y = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)

@@ -1,4 +1,6 @@
-"""Blockwise (flash) causal attention as a Pallas TPU kernel.
+"""Blockwise (flash) causal attention as a Pallas TPU kernel, with a
+flash-style Pallas backward (custom_vjp) so training/fine-tuning runs the
+kernel too.
 
 Replaces the XLA-native oracle (fei_tpu.ops.attention) for prefill, where the
 naive path materializes [B, T, S] scores in HBM. Here scores live only as
@@ -16,6 +18,13 @@ Kernel layout (SURVEY.md §7 step 4; the reference has no kernels to port):
 Per-sequence raggedness (cache length, causal offset) comes in as scalar
 prefetch so masks are built from SMEM scalars, never materialized in HBM.
 
+Backward (Dao et al. flash attention 2 recompute scheme): the forward
+additionally saves per-row logsumexp L = m + log(l); the backward
+recomputes p = exp(q·kᵀ·scale − L) tile-by-tile (never materializing the
+score matrix) in two kernels — one accumulating dq over k blocks, one
+accumulating dk/dv over q blocks — with D = rowsum(dO ∘ O) precomputed by
+XLA. GQA dk/dv are computed per query head and group-summed outside.
+
 On CPU test meshes the kernel runs in Pallas interpret mode (automatic), so
 the hermetic 8-device suite exercises the same code path as the TPU.
 """
@@ -26,10 +35,12 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
+_LANES = 128  # lane width for row-stat (lse/D) outputs — Mosaic-native
 
 
 def _fwd_kernel(
@@ -41,15 +52,20 @@ def _fwd_kernel(
     k_ref,  # [1, 1, block_k, D]
     v_ref,  # [1, 1, block_k, D]
     o_ref,  # [1, 1, block_q, D]
-    # scratch
-    m_ref,  # [block_q, 1] running max
-    l_ref,  # [block_q, 1] running sum
-    acc_ref,  # [block_q, D] running output accumulator
-    *,
+    # then, only when save_lse: lse_ref [1, 1, block_q, LANES] (row stats
+    # broadcast across lanes — Mosaic-native layout; lane 0 is read back)
+    # scratch: m [block_q,1] running max, l [block_q,1] running sum,
+    #          acc [block_q,D] running output accumulator
+    *rest,
     block_q: int,
     block_k: int,
     scale: float,
+    save_lse: bool,
 ):
+    if save_lse:
+        lse_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        lse_ref, (m_ref, l_ref, acc_ref) = None, rest
     b = pl.program_id(0)
     qi = pl.program_id(2)
     ki = pl.program_id(3)
@@ -110,14 +126,387 @@ def _fwd_kernel(
 
     @pl.when(ki == num_k - 1)
     def _finalize():
-        # rows with no live key (padding queries) have l == 0; emit zeros
+        # rows with no live key (padding queries) have l == 0; emit zeros,
+        # and +inf logsumexp so the backward's p = exp(s - L) is 0 there
         l = l_ref[:]
         safe_l = jnp.where(l == 0.0, 1.0, l)
         o_ref[0, 0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
+        if save_lse:
+            lse = jnp.where(
+                l == 0.0, jnp.inf, m_ref[:] + jnp.log(safe_l)
+            )  # [block_q, 1]
+            lse_ref[0, 0] = jnp.broadcast_to(lse, lse_ref.shape[2:])
 
 
 def _round_up(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
+
+
+def _resolve_blocks(T: int, S: int, block_q: int, block_k: int):
+    # Mosaic tiling: sublane (second-to-last) dim must be a multiple of 8
+    block_q = max(8, min(block_q, _round_up(T, 8)))
+    block_k = max(8, min(block_k, _round_up(S, 8)))
+    return block_q, block_k
+
+
+def _fwd_impl(
+    q, k, v, q_start, kv_length, scale, block_q, block_k, interpret,
+    save_lse,
+):
+    """Returns (out [B,T,H,D], lse or None). ``save_lse=False`` (the
+    inference primal) emits no logsumexp output at all — zero extra HBM."""
+    B, T, H, D = q.shape
+    S, K = k.shape[1], k.shape[2]
+    groups = H // K
+
+    block_q, block_k = _resolve_blocks(T, S, block_q, block_k)
+    T_pad = pl.cdiv(T, block_q) * block_q
+    S_pad = pl.cdiv(S, block_k) * block_k
+
+    # head-major so VMEM tiles are (seq, head_dim)
+    qt = jnp.transpose(q, (0, 2, 1, 3))  # [B, H, T, D]
+    kt = jnp.transpose(k, (0, 2, 1, 3))  # [B, K, S, D]
+    vt = jnp.transpose(v, (0, 2, 1, 3))
+    if T_pad != T:
+        qt = jnp.pad(qt, ((0, 0), (0, 0), (0, T_pad - T), (0, 0)))
+    if S_pad != S:
+        kt = jnp.pad(kt, ((0, 0), (0, 0), (0, S_pad - S), (0, 0)))
+        vt = jnp.pad(vt, ((0, 0), (0, 0), (0, S_pad - S), (0, 0)))
+
+    grid = (B, H, T_pad // block_q, S_pad // block_k)
+
+    kernel = functools.partial(
+        _fwd_kernel, block_q=block_q, block_k=block_k, scale=scale,
+        save_lse=save_lse,
+    )
+
+    outs = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec(
+                    (1, 1, block_q, D),
+                    lambda b, h, qi, ki, *_: (b, h, qi, 0),
+                ),
+                pl.BlockSpec(
+                    (1, 1, block_k, D),
+                    lambda b, h, qi, ki, *_: (b, h // groups, ki, 0),
+                ),
+                pl.BlockSpec(
+                    (1, 1, block_k, D),
+                    lambda b, h, qi, ki, *_: (b, h // groups, ki, 0),
+                ),
+            ],
+            out_specs=[
+                pl.BlockSpec(
+                    (1, 1, block_q, D),
+                    lambda b, h, qi, ki, *_: (b, h, qi, 0),
+                ),
+            ] + ([
+                pl.BlockSpec(
+                    (1, 1, block_q, _LANES),
+                    lambda b, h, qi, ki, *_: (b, h, qi, 0),
+                ),
+            ] if save_lse else []),
+            scratch_shapes=[
+                pltpu.VMEM((block_q, 1), jnp.float32),
+                pltpu.VMEM((block_q, 1), jnp.float32),
+                pltpu.VMEM((block_q, D), jnp.float32),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, T_pad, D), q.dtype),
+        ] + ([
+            jax.ShapeDtypeStruct((B, H, T_pad, _LANES), jnp.float32),
+        ] if save_lse else []),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(q_start.astype(jnp.int32), kv_length.astype(jnp.int32), qt, kt, vt)
+
+    out = jnp.transpose(outs[0][:, :, :T], (0, 2, 1, 3))
+    # residual keeps lane 0 only (128x smaller); bwd re-broadcasts
+    lse = outs[1][..., :1] if save_lse else None
+    return out, lse
+
+
+def _dq_kernel(
+    q_start_ref, kv_len_ref,
+    q_ref, k_ref, v_ref, do_ref,  # [1,1,bq,D] / [1,1,bk,D]
+    lse_ref, dsum_ref,  # [1,1,bq,_LANES] (lane 0 carries the value)
+    dq_ref,  # [1,1,bq,D] out
+    dq_acc,  # [bq, D] scratch
+    *, block_q, block_k, scale,
+):
+    b = pl.program_id(0)
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+    num_k = pl.num_programs(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    q_start = q_start_ref[b]
+    kv_len = kv_len_ref[b]
+    q_pos = q_start + qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    )
+    k_pos = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1
+    )
+    block_live = jnp.logical_and(
+        ki * block_k <= q_start + qi * block_q + block_q - 1,
+        ki * block_k < kv_len,
+    )
+
+    @pl.when(block_live)
+    def _compute():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
+        lse = lse_ref[0, 0][:, :1]  # [bq, 1] (lane 0)
+        dsum = dsum_ref[0, 0][:, :1]
+
+        s = jax.lax.dot_general(
+            q, k, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        mask = jnp.logical_and(k_pos <= q_pos, k_pos < kv_len)
+        s = jnp.where(mask, s, NEG_INF)
+        p = jnp.exp(s - lse)  # [bq, bk] (0 where masked or empty row)
+
+        dp = jax.lax.dot_general(
+            do, v, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [bq, bk]
+        ds = p * (dp - dsum)
+        dq_acc[:] += jax.lax.dot_general(
+            ds.astype(k.dtype), k,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+
+    @pl.when(ki == num_k - 1)
+    def _finalize():
+        dq_ref[0, 0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(
+    q_start_ref, kv_len_ref,
+    q_ref, k_ref, v_ref, do_ref,
+    lse_ref, dsum_ref,  # [1,1,bq,_LANES]
+    dk_ref, dv_ref,  # [1,1,bk,D] out (per query head)
+    dk_acc, dv_acc,  # [bk, D] scratch
+    *, block_q, block_k, scale,
+):
+    b = pl.program_id(0)
+    ki = pl.program_id(2)
+    qi = pl.program_id(3)
+    num_q = pl.num_programs(3)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    q_start = q_start_ref[b]
+    kv_len = kv_len_ref[b]
+    q_pos = q_start + qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    )
+    k_pos = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1
+    )
+    block_live = jnp.logical_and(
+        ki * block_k <= q_start + qi * block_q + block_q - 1,
+        ki * block_k < kv_len,
+    )
+
+    @pl.when(block_live)
+    def _compute():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
+        lse = lse_ref[0, 0][:, :1]  # lane 0
+        dsum = dsum_ref[0, 0][:, :1]
+
+        s = jax.lax.dot_general(
+            q, k, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        mask = jnp.logical_and(k_pos <= q_pos, k_pos < kv_len)
+        s = jnp.where(mask, s, NEG_INF)
+        p = jnp.exp(s - lse)  # [bq, bk]
+
+        # dv_j = sum_i p_ij dO_i
+        dv_acc[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do,
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do, v, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - dsum)  # [bq, bk]
+        # dk_j = scale * sum_i ds_ij q_i
+        dk_acc[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q,
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+
+    @pl.when(qi == num_q - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd_impl(
+    scale, block_q, block_k, interpret, res, dout
+):
+    q, k, v, q_start, kv_length, out, lse = res
+    B, T, H, D = q.shape
+    S, K = k.shape[1], k.shape[2]
+    groups = H // K
+
+    block_q, block_k = _resolve_blocks(T, S, block_q, block_k)
+    T_pad = pl.cdiv(T, block_q) * block_q
+    S_pad = pl.cdiv(S, block_k) * block_k
+
+    # D_i = rowsum(dO ∘ O): cheap elementwise reduce, XLA fuses it
+    dsum = jnp.sum(
+        dout.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
+    )  # [B, T, H]
+    dsum = jnp.transpose(dsum, (0, 2, 1))  # [B, H, T]
+
+    qt = jnp.transpose(q, (0, 2, 1, 3))
+    kt = jnp.transpose(k, (0, 2, 1, 3))
+    vt = jnp.transpose(v, (0, 2, 1, 3))
+    dot = jnp.transpose(dout, (0, 2, 1, 3))
+    if T_pad != T:
+        pad4 = ((0, 0), (0, 0), (0, T_pad - T), (0, 0))
+        qt = jnp.pad(qt, pad4)
+        dot = jnp.pad(dot, pad4)
+        dsum = jnp.pad(dsum, ((0, 0), (0, 0), (0, T_pad - T)))
+    if S_pad != S:
+        pad4 = ((0, 0), (0, 0), (0, S_pad - S), (0, 0))
+        kt = jnp.pad(kt, pad4)
+        vt = jnp.pad(vt, pad4)
+
+    # row stats ride lane-broadcast into the kernels (transient; the saved
+    # residual itself is lane-0 only)
+    lse = jnp.broadcast_to(lse, (*lse.shape[:-1], _LANES))
+    dsum = jnp.broadcast_to(dsum[..., None], (*dsum.shape, _LANES))
+    args = (q_start.astype(jnp.int32), kv_length.astype(jnp.int32),
+            qt, kt, vt, dot, lse, dsum)
+
+    q_spec = pl.BlockSpec(
+        (1, 1, block_q, D), lambda b, h, i, j, *_: (b, h, i, 0)
+    )
+    kv_spec_q = pl.BlockSpec(
+        (1, 1, block_k, D), lambda b, h, i, j, *_: (b, h // groups, j, 0)
+    )
+    row_spec = pl.BlockSpec(
+        (1, 1, block_q, _LANES), lambda b, h, i, j, *_: (b, h, i, 0)
+    )
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _dq_kernel, block_q=block_q, block_k=block_k, scale=scale
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(B, H, T_pad // block_q, S_pad // block_k),
+            in_specs=[q_spec, kv_spec_q, kv_spec_q, q_spec, row_spec, row_spec],
+            out_specs=pl.BlockSpec(
+                (1, 1, block_q, D), lambda b, h, i, j, *_: (b, h, i, 0)
+            ),
+            scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, H, T_pad, D), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(*args)
+
+    # dk/dv per query head (grid swaps: k blocks outer, q blocks inner)
+    q_spec_i = pl.BlockSpec(
+        (1, 1, block_q, D), lambda b, h, j, i, *_: (b, h, i, 0)
+    )
+    kv_spec_i = pl.BlockSpec(
+        (1, 1, block_k, D), lambda b, h, j, i, *_: (b, h // groups, j, 0)
+    )
+    row_spec_i = pl.BlockSpec(
+        (1, 1, block_q, _LANES), lambda b, h, j, i, *_: (b, h, i, 0)
+    )
+    dkv_out_spec = pl.BlockSpec(
+        (1, 1, block_k, D), lambda b, h, j, i, *_: (b, h, j, 0)
+    )
+
+    dk_h, dv_h = pl.pallas_call(
+        functools.partial(
+            _dkv_kernel, block_q=block_q, block_k=block_k, scale=scale
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(B, H, S_pad // block_k, T_pad // block_q),
+            in_specs=[
+                q_spec_i, kv_spec_i, kv_spec_i, q_spec_i, row_spec_i, row_spec_i
+            ],
+            out_specs=[dkv_out_spec, dkv_out_spec],
+            scratch_shapes=[
+                pltpu.VMEM((block_k, D), jnp.float32),
+                pltpu.VMEM((block_k, D), jnp.float32),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, S_pad, D), k.dtype),
+            jax.ShapeDtypeStruct((B, H, S_pad, D), v.dtype),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(*args)
+
+    dq = jnp.transpose(dq[:, :, :T], (0, 2, 1, 3))  # [B, T, H, D]
+    # GQA: sum each group's query-head contributions into its kv head
+    dk_h = dk_h[:, :, :S].reshape(B, K, groups, S, D).sum(axis=2)
+    dv_h = dv_h[:, :, :S].reshape(B, K, groups, S, D).sum(axis=2)
+    dk = jnp.transpose(dk_h, (0, 2, 1, 3))  # [B, S, K, D]
+    dv = jnp.transpose(dv_h, (0, 2, 1, 3))
+
+    # integer inputs (q_start, kv_length) take float0 cotangents
+    zero = lambda x: np.zeros(x.shape, dtype=jax.dtypes.float0)
+    return dq, dk, dv, zero(q_start), zero(kv_length)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3))
+def _flash(scale, block_q, block_k, interpret, q, k, v, q_start, kv_length):
+    out, _ = _fwd_impl(
+        q, k, v, q_start, kv_length, scale, block_q, block_k, interpret,
+        save_lse=False,
+    )
+    return out
+
+
+def _flash_fwd(scale, block_q, block_k, interpret, q, k, v, q_start, kv_length):
+    out, lse = _fwd_impl(
+        q, k, v, q_start, kv_length, scale, block_q, block_k, interpret,
+        save_lse=True,
+    )
+    return out, (q, k, v, q_start, kv_length, out, lse)
+
+
+_flash.defvjp(_flash_fwd, _bwd_impl)
 
 
 @functools.partial(
@@ -139,74 +528,12 @@ def flash_attention(
 
     Same contract as fei_tpu.ops.attention.attention: key position s is
     visible to the query at absolute position p iff s <= p and s < kv_length.
-    Returns [B, T, H, D] in q.dtype.
+    Returns [B, T, H, D] in q.dtype. Differentiable w.r.t. q/k/v via the
+    Pallas flash backward (recompute; O(T·D) memory both ways).
     """
-    B, T, H, D = q.shape
-    S, K = k.shape[1], k.shape[2]
-    groups = H // K
+    D = q.shape[-1]
     if scale is None:
         scale = D ** -0.5
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-
-    # Mosaic tiling: sublane (second-to-last) dim must be a multiple of 8
-    block_q = max(8, min(block_q, _round_up(T, 8)))
-    block_k = max(8, min(block_k, _round_up(S, 8)))
-
-    # pad T/S up to whole blocks; masks make padded work inert
-    T_pad = pl.cdiv(T, block_q) * block_q
-    S_pad = pl.cdiv(S, block_k) * block_k
-
-    # head-major so VMEM tiles are (seq, head_dim)
-    qt = jnp.transpose(q, (0, 2, 1, 3))  # [B, H, T, D]
-    kt = jnp.transpose(k, (0, 2, 1, 3))  # [B, K, S, D]
-    vt = jnp.transpose(v, (0, 2, 1, 3))
-    if T_pad != T:
-        qt = jnp.pad(qt, ((0, 0), (0, 0), (0, T_pad - T), (0, 0)))
-    if S_pad != S:
-        kt = jnp.pad(kt, ((0, 0), (0, 0), (0, S_pad - S), (0, 0)))
-        vt = jnp.pad(vt, ((0, 0), (0, 0), (0, S_pad - S), (0, 0)))
-
-    grid = (B, H, T_pad // block_q, S_pad // block_k)
-
-    kernel = functools.partial(
-        _fwd_kernel, block_q=block_q, block_k=block_k, scale=scale
-    )
-
-    out = pl.pallas_call(
-        kernel,
-        grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=2,
-            grid=grid,
-            in_specs=[
-                pl.BlockSpec(
-                    (1, 1, block_q, D),
-                    lambda b, h, qi, ki, *_: (b, h, qi, 0),
-                ),
-                pl.BlockSpec(
-                    (1, 1, block_k, D),
-                    lambda b, h, qi, ki, *_: (b, h // groups, ki, 0),
-                ),
-                pl.BlockSpec(
-                    (1, 1, block_k, D),
-                    lambda b, h, qi, ki, *_: (b, h // groups, ki, 0),
-                ),
-            ],
-            out_specs=pl.BlockSpec(
-                (1, 1, block_q, D),
-                lambda b, h, qi, ki, *_: (b, h, qi, 0),
-            ),
-            scratch_shapes=[
-                pltpu.VMEM((block_q, 1), jnp.float32),
-                pltpu.VMEM((block_q, 1), jnp.float32),
-                pltpu.VMEM((block_q, D), jnp.float32),
-            ],
-        ),
-        out_shape=jax.ShapeDtypeStruct((B, H, T_pad, D), q.dtype),
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
-        ),
-        interpret=interpret,
-    )(q_start.astype(jnp.int32), kv_length.astype(jnp.int32), qt, kt, vt)
-
-    return jnp.transpose(out[:, :, :T], (0, 2, 1, 3))
+    return _flash(scale, block_q, block_k, interpret, q, k, v, q_start, kv_length)

@@ -228,6 +228,104 @@ def handle_mcp_command(args) -> int:
     return 0
 
 
+def _extract_search_results(result) -> list[dict]:
+    """Normalize brave/MCP search payload shapes into [{title,url,description}].
+    (The reference parsed the same two shapes, fei/ui/cli.py:640-672.)"""
+    if not isinstance(result, dict):
+        return []
+    web = result.get("web")
+    rows = web.get("results") if isinstance(web, dict) else None
+    if rows is None:
+        rows = result.get("results")
+    if rows is None and "content" in result:
+        # MCP text content envelope: one blob, keep as a single pseudo-result
+        content = result["content"]
+        if isinstance(content, list):
+            text = "\n".join(
+                c.get("text", "") for c in content if isinstance(c, dict)
+            )
+        else:
+            text = str(content)
+        return [{"title": "search results", "url": "", "description": text}]
+    out = []
+    for r in rows or []:
+        if isinstance(r, dict):
+            out.append({
+                "title": str(r.get("title", "")),
+                "url": str(r.get("url", "")),
+                "description": str(r.get("description", r.get("snippet", ""))),
+            })
+    return out
+
+
+def run_search(query: str, count: int = 5, manager=None) -> list[dict]:
+    """Direct web search through the MCP brave service (falls back to the
+    direct REST API inside the service when no MCP server is configured;
+    unlike the reference there is NO hardcoded fallback API key —
+    ref fei/ui/cli.py:589 is a catalogued defect)."""
+    from fei_tpu.agent.mcp import MCPManager
+
+    own = manager is None
+    manager = manager or MCPManager()
+    try:
+        result = manager.brave_search.web_search(query, count=count)
+        return _extract_search_results(result)
+    finally:
+        if own:
+            manager.close()
+
+
+def handle_search_command(args) -> int:
+    try:
+        results = run_search(args.query, count=args.count)
+    except Exception as exc:  # noqa: BLE001 — network/MCP errors must be readable
+        print(f"search failed: {exc}", file=sys.stderr)
+        return 1
+    if not results:
+        print("no results")
+        return 0
+    for i, r in enumerate(results, 1):
+        print(f"{i}. {r['title']}\n   {r['url']}\n   {r['description']}\n")
+    return 0
+
+
+ASK_PROMPT = """Answer the question using the web search results below.
+Cite result numbers like [1] where they support your answer. If the results
+are insufficient, say what is missing.
+
+Search results for: {query}
+{results}
+
+Question: {query}"""
+
+
+def handle_ask_command(args) -> int:
+    """Search-stuffed one-shot ask (parity: ref fei/ui/cli.py:623-728)."""
+    results: list[dict] = []
+    if not args.no_search:
+        try:
+            results = run_search(args.query, count=args.count)
+        except Exception as exc:  # noqa: BLE001
+            print(f"[search unavailable: {exc}]", file=sys.stderr)
+    if results:
+        blob = "\n".join(
+            f"[{i}] {r['title']} — {r['url']}\n    {r['description']}"
+            for i, r in enumerate(results, 1)
+        )
+        prompt = ASK_PROMPT.format(query=args.query, results=blob)
+    else:
+        prompt = args.query
+    try:
+        assistant = build_assistant(args)
+    except Exception as exc:  # noqa: BLE001
+        print(f"error: {exc}", file=sys.stderr)
+        return 2
+    response = asyncio.run(assistant.chat(prompt))
+    emit_final(assistant, response)
+    History().add(f"[ask] {args.query}", response)
+    return 0
+
+
 def parse_args(argv: list[str] | None = None) -> argparse.Namespace:
     p = argparse.ArgumentParser(
         prog="fei", description="fei_tpu — TPU-native coding assistant"
@@ -252,6 +350,16 @@ def parse_args(argv: list[str] | None = None) -> argparse.Namespace:
     mcp.add_argument("service", nargs="?")
     mcp.add_argument("method", nargs="?")
     mcp.add_argument("--params", help="JSON params for mcp call")
+    search = sub.add_parser("search", help="direct web search (brave via MCP)")
+    search.add_argument("query")
+    search.add_argument("--count", type=int, default=5)
+    ask = sub.add_parser(
+        "ask", help="one-shot question answered with web-search context"
+    )
+    ask.add_argument("query")
+    ask.add_argument("--count", type=int, default=5)
+    ask.add_argument("--no-search", action="store_true",
+                     help="skip the search step, ask the model directly")
     return p.parse_args(argv)
 
 
@@ -262,6 +370,10 @@ def main(argv: list[str] | None = None) -> int:
         return handle_history_command(args)
     if args.command == "mcp":
         return handle_mcp_command(args)
+    if args.command == "search":
+        return handle_search_command(args)
+    if args.command == "ask":
+        return handle_ask_command(args)
     history = History()
     try:
         assistant = build_assistant(args)

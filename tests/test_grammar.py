@@ -305,6 +305,109 @@ class TestConstrainedDecode:
         assert isinstance(obj["q"], str)
 
 
+class TestRealVocabScale:
+    """VERDICT r1 weak-spot #9: the lift and the mask pipeline at Llama-3
+    vocab scale (128,256), with a locally built HF tokenizer (zero egress)."""
+
+    @pytest.fixture(scope="class")
+    def big_tok(self, tmp_path_factory):
+        transformers = pytest.importorskip("transformers")
+        tokenizers = pytest.importorskip("tokenizers")
+        from tokenizers import Tokenizer, decoders, models, pre_tokenizers
+
+        V = 128_256
+        # realistic-ish multi-char tokens: printable singles, then pairs,
+        # then triples until the vocab is full
+        chars = [chr(i) for i in range(32, 127)]
+        vocab: dict[str, int] = {}
+
+        def add(tok):
+            if tok not in vocab and len(vocab) < V - 2:
+                vocab[tok] = len(vocab)
+
+        for c in chars:
+            add(c)
+        for a in chars:
+            for b in chars:
+                add(a + b)
+        import itertools
+
+        for a, b, c in itertools.product(chars, chars, chars):
+            if len(vocab) >= V - 2:
+                break
+            add(a + b + c)
+        t = Tokenizer(models.WordLevel(vocab, unk_token=" "))
+        t.pre_tokenizer = pre_tokenizers.Split("", "isolated")
+        t.decoder = decoders.Fuse()
+        fast = transformers.PreTrainedTokenizerFast(
+            tokenizer_object=t, bos_token="<|bos|>", eos_token="<|eot|>"
+        )
+        path = tmp_path_factory.mktemp("bigtok")
+        fast.save_pretrained(str(path))
+        from fei_tpu.engine.tokenizer import HFTokenizer
+
+        tok = HFTokenizer(str(path))
+        assert tok.vocab_size >= 128_000
+        return tok
+
+    def test_lift_cost_and_size(self, big_tok):
+        schema = {
+            "type": "object",
+            "properties": {
+                "file_path": {"type": "string"},
+                "pattern": {"type": "string"},
+                "max_results": {"type": "integer"},
+                "recursive": {"type": "boolean"},
+            },
+            "required": ["file_path", "pattern"],
+        }
+        tg = compile_tool_call_grammar(schema, big_tok)
+        V = big_tok.vocab_size
+        n_states = tg.table.shape[0]
+        # measured + recorded: the vectorized lift must stay interactive
+        assert tg.lift_seconds < 60, f"lift took {tg.lift_seconds:.1f}s"
+        # int16 at this scale (state count far below 32k)
+        assert tg.table.dtype == np.int16
+        assert tg.table_bytes == n_states * V * 2
+        print(
+            f"\n[lift] states={n_states} vocab={V} "
+            f"time={tg.lift_seconds:.2f}s table={tg.table_bytes/1e6:.1f}MB"
+        )
+
+    def test_constrained_decode_at_scale(self, big_tok):
+        """Constrained output through the mask pipeline parses and matches
+        the schema at 128k vocab."""
+        import json
+
+        schema = {
+            "type": "object",
+            "properties": {
+                "path": {"type": "string"},
+                "limit": {"type": "integer"},
+            },
+            "required": ["path", "limit"],
+        }
+        tg = compile_tool_call_grammar(schema, big_tok)
+        rng = np.random.default_rng(0)
+        # random legal walk using the mask tables (tokenizer-level check —
+        # the engine pipeline is covered by TestOnDeviceConstrained)
+        s, out = tg.entry, []
+        for _ in range(64):
+            if s == tg.accept or s < 0:
+                break
+            legal = np.flatnonzero(tg.mask_table[s])
+            assert legal.size, "dead state in constrained walk"
+            t = int(rng.choice(legal))
+            out.append(t)
+            s = int(tg.table[s, t])
+        text = big_tok.decode(out)
+        if s != tg.accept:  # walk may still be mid-object; only check prefix
+            assert text.startswith('{"path":"')
+        else:
+            obj = json.loads(text)
+            assert set(obj) == {"path", "limit"}
+
+
 class TestOnDeviceConstrained:
     @pytest.fixture(scope="class")
     def engine(self):
