@@ -116,6 +116,52 @@ class MemdirFolderManager:
     def list_folders(self) -> list[str]:
         return self.store.list_folders()
 
+    def make_symlinks(self, target_dir: str | None = None) -> list[str]:
+        """Friendly (dot-less) symlinks to every folder, for shell/file-
+        manager navigation of the Maildir tree (parity: reference
+        memdir_tools/folders.py:382). Nested folders become nested link
+        directories; stale links are replaced, real files never touched.
+        Returns the created/refreshed link paths."""
+        target = os.path.abspath(
+            target_dir or os.path.join(self.store.base, "links")
+        )
+        os.makedirs(target, exist_ok=True)
+        created: list[str] = []
+        folders = [f for f in self.store.list_folders() if f]
+        folder_set = set(folders)
+        for folder in folders:
+            # skip the links dir itself (when placed inside the store base,
+            # it would otherwise self-reference)
+            if os.path.abspath(
+                self.store.folder_path(folder)
+            ).startswith(target + os.sep):
+                continue
+            # a subfolder whose ancestor is also linked is reachable
+            # through the ancestor's symlink; linking it separately would
+            # resolve through that symlink into the real store and fail
+            # the non-symlink guard
+            parts = folder.split("/")
+            if any("/".join(parts[:i]) in folder_set for i in range(1, len(parts))):
+                continue
+            friendly = "/".join(
+                part.lstrip(".") or part for part in folder.split("/")
+            )
+            link = os.path.join(target, friendly)
+            src = self.store.folder_path(folder)
+            os.makedirs(os.path.dirname(link), exist_ok=True)
+            if os.path.islink(link):
+                if os.readlink(link) == src:
+                    created.append(link)
+                    continue
+                os.unlink(link)
+            elif os.path.exists(link):
+                raise MemoryError_(
+                    f"refusing to replace non-symlink {link!r} with a link"
+                )
+            os.symlink(src, link)
+            created.append(link)
+        return created
+
     def get_folder_stats(self, name: str = "") -> dict:
         name = self._normalize(name) if name else name
         stats: dict = {

@@ -200,6 +200,120 @@ class GrepTool:
         return out
 
 
+def _check_brackets(content: str, single_quote: str = "string") -> str | None:
+    """Comment/string-aware bracket balance for brace-family languages.
+
+    Not a parser: it exists to reject the failure modes edits actually
+    produce (truncated blocks, a deleted closing brace) while never
+    rejecting valid code. ``single_quote``: "string" (js-family) treats
+    ``'…'`` as a string; "char" (c/java/go/rust) only consumes short char
+    literals so Rust lifetimes (``&'a``) and the like pass through.
+    """
+    pairs = {")": "(", "]": "[", "}": "{"}
+    stack: list[tuple[str, int]] = []
+    line = 1
+    i, n = 0, len(content)
+    prev_sig = "\n"  # last non-whitespace char outside comments/strings
+    while i < n:
+        c = content[i]
+        if c == "\n":
+            line += 1
+        elif c == "/" and i + 1 < n and content[i + 1] == "/":
+            i = content.find("\n", i)
+            if i < 0:
+                break
+            continue
+        elif (
+            c == "#"
+            and single_quote == "char"  # never JS: '#field' is a class member
+            and (i == 0 or content[i - 1] in "\n\t ")
+        ):
+            # C preprocessor / shell-style comment line: skip to EOL
+            i = content.find("\n", i)
+            if i < 0:
+                break
+            continue
+        elif c == "/" and i + 1 < n and content[i + 1] == "*":
+            end = content.find("*/", i + 2)
+            if end < 0:
+                return f"unterminated block comment starting line {line}"
+            line += content.count("\n", i, end)
+            i = end + 2
+            continue
+        elif (
+            c == "/" and single_quote == "string"
+            and prev_sig in "(=,:[!&|?{};\n<>+-*%~^"
+        ):
+            # JS regex literal (the standard lexer heuristic: '/' after an
+            # operator/opener is a regex, after a value it's division) —
+            # quotes/brackets inside must not be parsed as code
+            j, in_class = i + 1, False
+            while j < n and content[j] != "\n":
+                cj = content[j]
+                if cj == "\\":
+                    j += 2
+                    continue
+                if cj == "[":
+                    in_class = True
+                elif cj == "]":
+                    in_class = False
+                elif cj == "/" and not in_class:
+                    break
+                j += 1
+            if j < n and content[j] == "/":
+                i = j + 1
+                prev_sig = "/"
+                continue
+            # no closing '/': treat as division, fall through
+        elif c == "'" and single_quote == "char":
+            # consume only a genuine char literal: exactly one char ('a',
+            # '{') or an escape ('\n', '\u{1F600}'). A lone quote (Rust
+            # lifetime, apostrophe) is plain text — a 12-char window with
+            # any closing quote would swallow code like <'a>(x: &'a [u8]).
+            j, limit = i + 1, min(i + 12, n)
+            is_escape = j < n and content[j] == "\\"
+            while j < limit and content[j] != "'" and content[j] != "\n":
+                j += 2 if content[j] == "\\" else 1
+            if (
+                j < limit and content[j] == "'"
+                and (j == i + 2 or is_escape)
+            ):
+                i = j + 1
+                continue
+        elif c in ("'", '"', "`") and not (c == "'" and single_quote == "char"):
+            quote, start_line = c, line
+            i += 1
+            while i < n:
+                if content[i] == "\\":
+                    i += 2
+                    continue
+                if content[i] == "\n":
+                    line += 1
+                    if quote != "`":  # ordinary strings don't span lines
+                        break
+                if content[i] == quote:
+                    break
+                i += 1
+            if i >= n:
+                return f"unterminated string starting line {start_line}"
+            i += 1
+            prev_sig = quote  # a string is a value: '/' after it is division
+            continue
+        elif c in "([{":
+            stack.append((c, line))
+        elif c in ")]}":
+            if not stack or stack[-1][0] != pairs[c]:
+                return f"unbalanced {c!r} at line {line}"
+            stack.pop()
+        if not c.isspace():
+            prev_sig = c
+        i += 1
+    if stack:
+        ch, ln = stack[-1]
+        return f"unclosed {ch!r} opened at line {ln}"
+    return None
+
+
 class CodeEditor:
     """Edit/create/replace files with rolling backups and syntax validation."""
 
@@ -228,16 +342,51 @@ class CodeEditor:
         return dest
 
     @staticmethod
-    def _validate_python(path: str, content: str) -> str | None:
-        if not path.endswith(".py"):
-            return None
-        import ast
+    def _validate_code(path: str, content: str) -> str | None:
+        """Tiered post-edit validation (capability parity with the
+        reference's ast→esprima→pylint/flake8 ladder at
+        fei/tools/code.py:827-932, without its external-tool deps):
 
-        try:
-            ast.parse(content)
-            return None
-        except SyntaxError as exc:
-            return f"python syntax error at line {exc.lineno}: {exc.msg}"
+        - .py          — exact: stdlib ast
+        - .json        — exact: json.loads
+        - .yaml/.yml   — exact when PyYAML importable, else skipped
+        - brace langs  — js/ts/c/c++/java/go/rust: comment/string-aware
+                         bracket balance (catches the truncated-edit and
+                         mismatched-block failures edits actually produce)
+        - anything else— no validation (plain text is always legal)
+        """
+        ext = os.path.splitext(path)[1].lower()
+        if ext == ".py":
+            import ast
+
+            try:
+                ast.parse(content)
+                return None
+            except SyntaxError as exc:
+                return f"python syntax error at line {exc.lineno}: {exc.msg}"
+        if ext == ".json":
+            import json
+
+            try:
+                json.loads(content)
+                return None
+            except ValueError as exc:
+                return f"invalid json: {exc}"
+        if ext in (".yaml", ".yml"):
+            try:
+                import yaml
+            except ImportError:
+                return None
+            try:
+                yaml.safe_load(content)
+                return None
+            except yaml.YAMLError as exc:
+                return f"invalid yaml: {exc}"
+        if ext in (".js", ".jsx", ".ts", ".tsx", ".mjs", ".cjs"):
+            return _check_brackets(content, single_quote="string")
+        if ext in (".c", ".h", ".cc", ".cpp", ".hpp", ".java", ".go", ".rs"):
+            return _check_brackets(content, single_quote="char")
+        return None
 
     def edit_file(self, file_path: str, old_string: str, new_string: str) -> dict:
         """Unique-match replace; empty old_string creates a new file.
@@ -259,7 +408,7 @@ class CodeEditor:
                 f"old_string matches {count} locations — add surrounding context to make it unique"
             )
         new_content = content.replace(old_string, new_string, 1)
-        err = self._validate_python(file_path, new_content)
+        err = self._validate_code(file_path, new_content)
         if err:
             raise ToolError(f"edit rejected, result does not parse: {err}")
         backup = self._backup(file_path)
@@ -269,7 +418,7 @@ class CodeEditor:
     def create_file(self, file_path: str, content: str) -> dict:
         if os.path.exists(file_path):
             raise ToolError(f"file already exists: {file_path} (use Replace to overwrite)")
-        err = self._validate_python(file_path, content)
+        err = self._validate_code(file_path, content)
         if err:
             raise ToolError(f"create rejected, content does not parse: {err}")
         os.makedirs(os.path.dirname(os.path.abspath(file_path)), exist_ok=True)
@@ -277,7 +426,7 @@ class CodeEditor:
         return {"file_path": file_path, "created": True, "bytes": len(content.encode())}
 
     def replace_file(self, file_path: str, content: str) -> dict:
-        err = self._validate_python(file_path, content)
+        err = self._validate_code(file_path, content)
         if err:
             raise ToolError(f"replace rejected, content does not parse: {err}")
         backup = self._backup(file_path)
@@ -297,7 +446,7 @@ class CodeEditor:
         if n == 0:
             return {"file_path": file_path, "replaced": 0}
         if validate:
-            err = self._validate_python(file_path, new_content)
+            err = self._validate_code(file_path, new_content)
             if err:
                 raise ToolError(f"regex edit rejected, result does not parse: {err}")
         backup = self._backup(file_path)
@@ -482,7 +631,7 @@ DENIED_PATTERNS = [
 ]
 
 INTERACTIVE_COMMANDS = {"vi", "vim", "nano", "emacs", "less", "more", "top", "htop",
-                        "ssh", "ftp", "telnet", "python -i"}
+                        "ssh", "ftp", "telnet"}
 
 
 class ShellRunner:
@@ -494,13 +643,8 @@ class ShellRunner:
         self._lock = threading.RLock()
         self._background: dict[int, subprocess.Popen] = {}
 
-    def check_command(self, command: str) -> str | None:
-        """Return a denial reason, or None if the command is allowed."""
-        for rx in self.denied:
-            if rx.search(command):
-                return f"command denied by policy: {rx.pattern}"
-        # Tokenize with quote awareness, then split segments at control
-        # operators so every program in a pipeline/sequence is checked.
+    def _segments(self, command: str) -> list[list[str]] | str:
+        """Quote-aware pipeline segmentation; str return = parse error."""
         try:
             lex = shlex.shlex(command, posix=True, punctuation_chars=True)
             lex.whitespace_split = True
@@ -515,18 +659,62 @@ class ShellRunner:
                 continue
             else:
                 segments[-1].append(tok)
+        return segments
+
+    @staticmethod
+    def _segment_prog(argv: list[str]) -> str | None:
+        # skip env-var assignments prefix (FOO=bar cmd ...)
+        i = 0
+        while i < len(argv) and re.match(r"^[A-Za-z_][A-Za-z0-9_]*=", argv[i]):
+            i += 1
+        return os.path.basename(argv[i]) if i < len(argv) else None
+
+    def is_interactive(self, command: str) -> bool:
+        """Heuristic from the reference (fei/tools/code.py:1494-1519): any
+        pipeline segment whose program expects a terminal. Allowed
+        interactive commands run under the PTY wrapper with prompt
+        auto-confirmation instead of hanging on a missing tty; note the
+        allowlist still gates first, so members of INTERACTIVE_COMMANDS
+        only reach the PTY when a caller's custom allowlist includes them
+        — the default allowlist admits only the flag/subcommand cases
+        (python -i, git rebase -i, npm init, pip uninstall)."""
+        segments = self._segments(command)
+        if isinstance(segments, str):
+            return False
+        progs = {self._segment_prog(a) for a in segments}
+        if progs & INTERACTIVE_COMMANDS:
+            return True
+        # flag/subcommand-based interactivity of otherwise-batch programs
         for argv in segments:
-            # skip env-var assignments prefix (FOO=bar cmd ...)
-            i = 0
-            while i < len(argv) and re.match(r"^[A-Za-z_][A-Za-z0-9_]*=", argv[i]):
-                i += 1
-            if i >= len(argv):
+            prog = self._segment_prog(argv)
+            rest = argv[argv.index(prog) if prog in argv else 0:]
+            if prog in ("python", "python3") and "-i" in rest:
+                return True
+            if prog == "git" and (
+                ("rebase" in rest and "-i" in rest)
+                or ("add" in rest and ("-p" in rest or "-i" in rest))
+            ):
+                return True
+            if prog == "npm" and any(s in rest for s in ("init", "login")):
+                return True
+            if prog == "pip" and "uninstall" in rest and "-y" not in rest:
+                return True
+        return False
+
+    def check_command(self, command: str) -> str | None:
+        """Return a denial reason, or None if the command is allowed."""
+        for rx in self.denied:
+            if rx.search(command):
+                return f"command denied by policy: {rx.pattern}"
+        segments = self._segments(command)
+        if isinstance(segments, str):
+            return segments
+        for argv in segments:
+            prog = self._segment_prog(argv)
+            if prog is None:
                 continue
-            prog = os.path.basename(argv[i])
             if prog not in self.allowed:
                 return f"command not in allowlist: {prog}"
-            if prog in INTERACTIVE_COMMANDS:
-                return f"interactive command not supported: {prog}"
         return None
 
     def run(
@@ -541,6 +729,8 @@ class ShellRunner:
             return {"error": reason, "exit_code": -1}
         if background:
             return self._run_background(command, timeout, cwd)
+        if self.is_interactive(command):
+            return self._run_pty(command, timeout, cwd)
         try:
             proc = subprocess.run(
                 command,
@@ -565,6 +755,31 @@ class ShellRunner:
             }
         except subprocess.TimeoutExpired:
             return {"error": f"command timed out after {timeout}s", "exit_code": -1}
+
+    def _run_pty(self, command: str, timeout: int, cwd: str | None) -> dict:
+        """Run an interactive command under the PTY wrapper: it gets a real
+        tty and its confirmation prompts are auto-answered
+        (tools/pty_wrapper.py; reference behavior claude_wrapper.js:48-60
+        generalized). Output is the captured transcript."""
+        from fei_tpu.tools.pty_wrapper import PtyWrapper
+
+        if cwd:
+            command = f"cd {shlex.quote(cwd)} && {command}"
+        try:
+            wrapper = PtyWrapper(
+                ["bash", "-c", command], echo=False, timeout=float(timeout)
+            )
+            code = wrapper.run()
+            out = wrapper.output
+            truncated = len(out) > MAX_OUTPUT_CHARS
+            if truncated:
+                out = out[:MAX_OUTPUT_CHARS] + "\n…[truncated]"
+            return {
+                "stdout": out, "stderr": "", "exit_code": code,
+                "interactive": True, "truncated": truncated,
+            }
+        except Exception as exc:  # noqa: BLE001 — pty can fail in odd envs
+            return {"error": f"pty execution failed: {exc}", "exit_code": -1}
 
     def _run_background(self, command: str, timeout: int, cwd: str | None) -> dict:
         proc = subprocess.Popen(

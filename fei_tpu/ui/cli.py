@@ -205,10 +205,42 @@ def handle_history_command(args) -> int:
     return 0
 
 
+# discovery methods MCP servers variously answer (parity: the reference's
+# check_mcp_methods.py:1-102 probe script, without its hardcoded API key)
+_PROBE_METHODS = [
+    "initialize", "tools/list", "listTools", "list_tools",
+    "resources/list", "prompts/list", "rpc.discover", "system.listMethods",
+]
+
+
+def handle_mcp_probe(args, manager) -> int:
+    """Probe which discovery methods a configured MCP service answers."""
+    service = args.service
+    if not service:
+        print("usage: fei mcp probe <service>", file=sys.stderr)
+        return 2
+    found = 0
+    for method in _PROBE_METHODS:
+        try:
+            result = manager.client.call_service(service, method, {})
+            found += 1
+            blob = json.dumps(result, default=str)
+            print(f"✓ {method}: {blob[:200]}{'…' if len(blob) > 200 else ''}")
+        except Exception as exc:  # noqa: BLE001 — probing expects failures
+            print(f"✗ {method}: {exc}")
+    print(f"\n{found}/{len(_PROBE_METHODS)} discovery methods answered")
+    return 0 if found else 1
+
+
 def handle_mcp_command(args) -> int:
     from fei_tpu.agent.mcp import MCPManager
 
     manager = MCPManager()
+    if args.mcp_action == "probe":
+        try:
+            return handle_mcp_probe(args, manager)
+        finally:
+            manager.close()
     if args.mcp_action == "list":
         if not manager.client.servers:
             print("no mcp servers configured (set FEI_TPU_MCP_SERVER_<NAME> "
@@ -346,7 +378,7 @@ def parse_args(argv: list[str] | None = None) -> argparse.Namespace:
     hist.add_argument("history_action", choices=["list", "show", "clear", "load"])
     hist.add_argument("index", nargs="?", type=int, default=0)
     mcp = sub.add_parser("mcp", help="MCP service operations")
-    mcp.add_argument("mcp_action", choices=["list", "call"])
+    mcp.add_argument("mcp_action", choices=["list", "call", "probe"])
     mcp.add_argument("service", nargs="?")
     mcp.add_argument("method", nargs="?")
     mcp.add_argument("--params", help="JSON params for mcp call")

@@ -207,3 +207,29 @@ class TestServicesAndRegistry:
         mgr.client.add_http_server("github", http_rpc)
         assert mgr.github.search_repositories("jax")["echo"] == "search_repositories"
         assert mgr.github.get_file_contents("o", "r", "p")["echo"] == "get_file_contents"
+
+    def test_mcp_probe_subcommand(self, http_rpc, capsys):
+        """fei mcp probe — discovery-method probing (parity: the reference's
+        check_mcp_methods.py, no hardcoded key)."""
+        import argparse
+
+        from fei_tpu.ui.cli import handle_mcp_probe
+
+        mgr = MCPManager()
+        mgr.client.add_http_server("probeme", http_rpc)
+        args = argparse.Namespace(service="probeme")
+        rc = handle_mcp_probe(args, mgr)
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "✓ tools/list" in out and "discovery methods answered" in out
+
+    def test_mcp_probe_unknown_service(self, capsys):
+        import argparse
+
+        from fei_tpu.ui.cli import handle_mcp_probe
+
+        mgr = MCPManager()
+        args = argparse.Namespace(service="ghost")
+        rc = handle_mcp_probe(args, mgr)
+        assert rc == 1
+        assert "0/" in capsys.readouterr().out

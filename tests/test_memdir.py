@@ -252,6 +252,30 @@ class TestFolders:
         mems = store.list(".Backup", "new", with_content=True)
         assert all("archived" in m.tags for m in mems)
 
+    def test_make_symlinks(self, store):
+        """Dot-less navigation links (parity: ref folders.py:382)."""
+        import os
+
+        mgr = MemdirFolderManager(store)
+        mgr.create_folder("Projects/Go")
+        links = mgr.make_symlinks()
+        by_name = {os.path.relpath(l, os.path.join(store.base, "links")): l
+                   for l in links}
+        assert "Projects/Go" in by_name
+        link = by_name["Projects/Go"]
+        assert os.path.islink(link)
+        assert os.path.realpath(link) == os.path.realpath(
+            store.folder_path(".Projects/Go")
+        )
+        # idempotent: second run refreshes, never errors
+        assert sorted(mgr.make_symlinks()) == sorted(links)
+        # refuses to clobber a real file
+        clobber = os.path.join(store.base, "links", "Real")
+        open(clobber, "w").write("x")
+        mgr.create_folder("Real")
+        with pytest.raises(MemoryError_):
+            mgr.make_symlinks()
+
 
 class TestServer:
     @pytest.fixture

@@ -195,6 +195,109 @@ class TestEditor:
         assert "fetch_config" in open(f).read()
 
 
+class TestMultiLanguageValidation:
+    """Tiered edit validation beyond Python (VERDICT r1 missing #3;
+    reference ladder fei/tools/code.py:827-932)."""
+
+    def _edit(self, tmp_path, name, content, old, new):
+        f = tmp_path / name
+        f.write_text(content)
+        return CodeEditor().edit_file(str(f), old, new)
+
+    def test_json_rejected(self, tmp_path):
+        with pytest.raises(ToolError, match="invalid json"):
+            self._edit(tmp_path, "cfg.json", '{"a": 1}', '"a": 1', '"a": 1,')
+
+    def test_json_accepted(self, tmp_path):
+        self._edit(tmp_path, "cfg.json", '{"a": 1}', '"a": 1', '"a": 2')
+
+    def test_js_unbalanced_rejected(self, tmp_path):
+        src = "function f() {\n  return [1, 2];\n}\n"
+        with pytest.raises(ToolError, match="does not parse"):
+            self._edit(tmp_path, "app.js", src, "return [1, 2];\n}", "return [1, 2];")
+
+    def test_js_strings_and_comments_ignored(self, tmp_path):
+        src = 'const s = "a { b";  // comment with }\nlet x = [1];\n'
+        self._edit(tmp_path, "ok.js", src, "[1]", "[2]")
+
+    def test_cpp_char_literals(self, tmp_path):
+        src = "int f() {\n  char c = '{';\n  return (int)c;\n}\n"
+        self._edit(tmp_path, "a.cpp", src, "return (int)c;", "return 0;")
+
+    def test_rust_lifetimes_pass(self, tmp_path):
+        src = "fn first<'a>(x: &'a [u8]) -> &'a u8 {\n  &x[0]\n}\n"
+        self._edit(tmp_path, "lib.rs", src, "&x[0]", "&x[1]")
+
+    def test_go_truncated_rejected(self, tmp_path):
+        src = "func main() {\n\tprintln(1)\n}\n"
+        with pytest.raises(ToolError, match="does not parse"):
+            self._edit(tmp_path, "main.go", src, "println(1)\n}", "println(1)")
+
+    def test_yaml_rejected_if_pyyaml(self, tmp_path):
+        pytest.importorskip("yaml")
+        with pytest.raises(ToolError, match="invalid yaml"):
+            self._edit(tmp_path, "c.yaml", "a: 1\n", "a: 1", "a: [1,\n")
+
+    def test_plain_text_never_validated(self, tmp_path):
+        self._edit(tmp_path, "notes.txt", "{ [ (((\n", "(((", "((((")
+
+    def test_js_private_fields_pass(self, tmp_path):
+        src = "class A {\n  #run() {\n    return 1;\n  }\n}\n"
+        self._edit(tmp_path, "cls.js", src, "return 1;", "return 2;")
+
+    def test_js_regex_literal_pass(self, tmp_path):
+        src = 'const parts = s.split(/"/);\nlet m = x.match(/[)/]+/g);\n'
+        self._edit(tmp_path, "re.js", src, "let m", "const m")
+
+    def test_c_preprocessor_skipped(self, tmp_path):
+        src = "#include <stdio.h>\nint f() {\n  return 0;\n}\n"
+        self._edit(tmp_path, "m.c", src, "return 0;", "return 1;")
+
+
+class TestInteractiveRouting:
+    """Interactive commands run under the PTY wrapper (VERDICT r1 missing
+    #4; reference heuristic fei/tools/code.py:1494-1519)."""
+
+    def test_detection(self):
+        r = ShellRunner()
+        assert r.is_interactive("vim notes.txt")
+        assert r.is_interactive("python -i script.py")
+        assert r.is_interactive("git rebase -i HEAD~3")
+        assert r.is_interactive("npm init")
+        assert not r.is_interactive("python script.py")
+        assert not r.is_interactive("git rebase --continue")
+        assert not r.is_interactive("pip uninstall -y pkg")
+
+    def test_interactive_runs_under_pty(self):
+        """An allowlisted interactive invocation gets a real tty."""
+        out = ShellRunner().run(
+            "python -i -c 'import sys; print(sys.stdin.isatty()); sys.exit(0)'",
+            timeout=15,
+        )
+        assert out.get("interactive") is True
+        assert "True" in out.get("stdout", "")
+
+    def test_noninteractive_unchanged(self):
+        out = ShellRunner().run("echo plain")
+        assert "interactive" not in out and out["exit_code"] == 0
+
+    def test_default_allowlist_still_blocks_editors(self):
+        out = ShellRunner().run("vim notes.txt")
+        assert "not in allowlist" in out["error"]
+
+    def test_custom_allowlist_routes_editor_to_pty(self, tmp_path):
+        """A caller that allowlists an INTERACTIVE_COMMANDS member gets the
+        PTY path, not a hang on a missing tty."""
+        from fei_tpu.tools.code import ALLOWED_COMMANDS
+
+        f = tmp_path / "small.txt"
+        f.write_text("one line\n")
+        r = ShellRunner(allowed=ALLOWED_COMMANDS | {"more"})
+        out = r.run(f"more {f}", timeout=10)
+        assert out.get("interactive") is True
+        assert "one line" in out.get("stdout", "")
+
+
 class TestViewerExplorer:
     def test_view_numbers_lines(self, tree):
         out = FileViewer().view(str(tree / "README.md"))
