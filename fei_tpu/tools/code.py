@@ -200,20 +200,33 @@ class GrepTool:
         return out
 
 
-def _check_brackets(content: str, single_quote: str = "string") -> str | None:
+def _check_brackets(content: str, lang: str = "js") -> str | None:
     """Comment/string-aware bracket balance for brace-family languages.
 
     Not a parser: it exists to reject the failure modes edits actually
     produce (truncated blocks, a deleted closing brace) while never
-    rejecting valid code. ``single_quote``: "string" (js-family) treats
-    ``'…'`` as a string; "char" (c/java/go/rust) only consumes short char
-    literals so Rust lifetimes (``&'a``) and the like pass through.
+    rejecting valid code. ``lang``:
+
+    - "js"    — ``'…'`` is a string; regex literals (after operators or
+                regex-context keywords) are skipped; ``#field`` is code.
+    - "c"     — preprocessor lines (incl. backslash continuations) are
+                skipped; single quotes are short char literals only.
+    - "brace" — go/java/rust: char-literal single quotes (so Rust
+                lifetimes pass), no preprocessor, no regex literals.
     """
     pairs = {")": "(", "]": "[", "}": "{"}
+    # '/' after these starts a regex, after a value it's division — the
+    # standard JS lexer heuristic, extended with regex-context keywords
+    _REGEX_PUNCT = "(=,:[!&|?{};\n<>+-*%~^"
+    _REGEX_KEYWORDS = {
+        "return", "typeof", "case", "in", "of", "delete", "void", "do",
+        "else", "instanceof", "new", "throw", "yield", "await",
+    }
     stack: list[tuple[str, int]] = []
     line = 1
     i, n = 0, len(content)
     prev_sig = "\n"  # last non-whitespace char outside comments/strings
+    word = ""  # identifier/keyword accumulator ending at prev_sig
     while i < n:
         c = content[i]
         if c == "\n":
@@ -225,13 +238,21 @@ def _check_brackets(content: str, single_quote: str = "string") -> str | None:
             continue
         elif (
             c == "#"
-            and single_quote == "char"  # never JS: '#field' is a class member
+            and lang == "c"  # never JS ('#field' is a class member) or rust
             and (i == 0 or content[i - 1] in "\n\t ")
         ):
-            # C preprocessor / shell-style comment line: skip to EOL
-            i = content.find("\n", i)
-            if i < 0:
-                break
+            # preprocessor line: skip to EOL, following backslash
+            # continuations (#define WRAP(x) do { \ ... } while (0))
+            while True:
+                eol = content.find("\n", i)
+                if eol < 0:
+                    i = n
+                    break
+                line += 1
+                i = eol + 1
+                skipped = content[content.rfind("\n", 0, eol) + 1:eol]
+                if not skipped.rstrip().endswith("\\"):
+                    break
             continue
         elif c == "/" and i + 1 < n and content[i + 1] == "*":
             end = content.find("*/", i + 2)
@@ -241,12 +262,10 @@ def _check_brackets(content: str, single_quote: str = "string") -> str | None:
             i = end + 2
             continue
         elif (
-            c == "/" and single_quote == "string"
-            and prev_sig in "(=,:[!&|?{};\n<>+-*%~^"
+            c == "/" and lang == "js"
+            and (prev_sig in _REGEX_PUNCT or word in _REGEX_KEYWORDS)
         ):
-            # JS regex literal (the standard lexer heuristic: '/' after an
-            # operator/opener is a regex, after a value it's division) —
-            # quotes/brackets inside must not be parsed as code
+            # regex literal — quotes/brackets inside are not code
             j, in_class = i + 1, False
             while j < n and content[j] != "\n":
                 cj = content[j]
@@ -262,13 +281,13 @@ def _check_brackets(content: str, single_quote: str = "string") -> str | None:
                 j += 1
             if j < n and content[j] == "/":
                 i = j + 1
-                prev_sig = "/"
+                prev_sig, word = "/", ""
                 continue
             # no closing '/': treat as division, fall through
-        elif c == "'" and single_quote == "char":
+        elif c == "'" and lang in ("c", "brace"):
             # consume only a genuine char literal: exactly one char ('a',
             # '{') or an escape ('\n', '\u{1F600}'). A lone quote (Rust
-            # lifetime, apostrophe) is plain text — a 12-char window with
+            # lifetime, apostrophe) is plain text — a wide window with
             # any closing quote would swallow code like <'a>(x: &'a [u8]).
             j, limit = i + 1, min(i + 12, n)
             is_escape = j < n and content[j] == "\\"
@@ -280,7 +299,7 @@ def _check_brackets(content: str, single_quote: str = "string") -> str | None:
             ):
                 i = j + 1
                 continue
-        elif c in ("'", '"', "`") and not (c == "'" and single_quote == "char"):
+        elif c in ("'", '"', "`") and not (c == "'" and lang in ("c", "brace")):
             quote, start_line = c, line
             i += 1
             while i < n:
@@ -297,7 +316,7 @@ def _check_brackets(content: str, single_quote: str = "string") -> str | None:
             if i >= n:
                 return f"unterminated string starting line {start_line}"
             i += 1
-            prev_sig = quote  # a string is a value: '/' after it is division
+            prev_sig, word = quote, ""  # a string is a value: '/' divides
             continue
         elif c in "([{":
             stack.append((c, line))
@@ -307,6 +326,7 @@ def _check_brackets(content: str, single_quote: str = "string") -> str | None:
             stack.pop()
         if not c.isspace():
             prev_sig = c
+            word = word + c if (c.isalnum() or c in "_$") else ""
         i += 1
     if stack:
         ch, ln = stack[-1]
@@ -383,9 +403,11 @@ class CodeEditor:
             except yaml.YAMLError as exc:
                 return f"invalid yaml: {exc}"
         if ext in (".js", ".jsx", ".ts", ".tsx", ".mjs", ".cjs"):
-            return _check_brackets(content, single_quote="string")
-        if ext in (".c", ".h", ".cc", ".cpp", ".hpp", ".java", ".go", ".rs"):
-            return _check_brackets(content, single_quote="char")
+            return _check_brackets(content, lang="js")
+        if ext in (".c", ".h", ".cc", ".cpp", ".hpp"):
+            return _check_brackets(content, lang="c")
+        if ext in (".java", ".go", ".rs"):
+            return _check_brackets(content, lang="brace")
         return None
 
     def edit_file(self, file_path: str, old_string: str, new_string: str) -> dict:
@@ -774,10 +796,14 @@ class ShellRunner:
             truncated = len(out) > MAX_OUTPUT_CHARS
             if truncated:
                 out = out[:MAX_OUTPUT_CHARS] + "\n…[truncated]"
-            return {
+            result = {
                 "stdout": out, "stderr": "", "exit_code": code,
                 "interactive": True, "truncated": truncated,
             }
+            if wrapper.timed_out:
+                # same contract as the subprocess path: timeouts are errors
+                result["error"] = f"command timed out after {timeout}s"
+            return result
         except Exception as exc:  # noqa: BLE001 — pty can fail in odd envs
             return {"error": f"pty execution failed: {exc}", "exit_code": -1}
 

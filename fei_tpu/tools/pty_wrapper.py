@@ -60,6 +60,7 @@ class PtyWrapper:
         self.timeout = timeout
         self.response_cooldown = response_cooldown
         self.transcript: list[str] = []
+        self.timed_out = False  # set when the timeout killed the child
 
     def run(self) -> int:
         """Run the command under a pty until it exits. Returns the exit code."""
@@ -79,6 +80,7 @@ class PtyWrapper:
             while True:
                 if self.timeout and time.monotonic() - start > self.timeout:
                     log.warning("pty wrapper timeout; killing %s", self.command[0])
+                    self.timed_out = True
                     os.kill(pid, 9)
                     break
                 ready, _, _ = select.select([master], [], [], 0.25)
