@@ -166,11 +166,16 @@ def bench_paged(model: str, n_tokens: int) -> int:
             max_new_tokens=n_tokens, temperature=0.0, ignore_eos=True
         )
 
+        errors: list = []
+
         def consume(counts, idx):
-            n = 0
-            for _ in engine.scheduler.stream(prompt, gen):
-                n += 1
-            counts[idx] = n
+            try:
+                n = 0
+                for _ in engine.scheduler.stream(prompt, gen):
+                    n += 1
+                counts[idx] = n
+            except BaseException as exc:  # noqa: BLE001 — re-raised below
+                errors.append(exc)
 
         # warm-up round compiles admit/step programs
         log(f"bench: paged warm-up ({streams} streams)...")
@@ -184,21 +189,24 @@ def bench_paged(model: str, n_tokens: int) -> int:
             t.start()
         for t in threads:
             t.join()
-        if not any(counts):
-            raise RuntimeError("paged warm-up produced no tokens")
+        if errors:
+            raise errors[0]
+        if not all(counts):
+            raise RuntimeError(f"paged warm-up incomplete: tokens={counts}")
         log(f"bench: warm-up {time.time()-t0:.1f}s, tokens={counts}")
-        return engine, consume
+        return engine, consume, errors
 
     try:
-        engine, consume = build_and_warm()
+        engine, consume, errors = build_and_warm()
     except Exception as exc:  # noqa: BLE001 — pallas must never sink the bench
         log(f"bench: paged warm-up failed ({exc!r}); retrying FEI_TPU_FLASH=0")
         os.environ["FEI_TPU_FLASH"] = "0"
-        engine, consume = build_and_warm()
+        engine, consume, errors = build_and_warm()
 
     best = 0.0
     for run in range(2):
         counts = [0] * streams
+        errors.clear()
         threads = [
             threading.Thread(target=consume, args=(counts, i))
             for i in range(streams)
@@ -208,6 +216,8 @@ def bench_paged(model: str, n_tokens: int) -> int:
             t.start()
         for t in threads:
             t.join()
+        if errors:  # a failed stream must sink the run, not deflate it
+            raise errors[0]
         dt = time.time() - t0
         agg = sum(counts) / dt
         log(f"bench: paged run {run}: {sum(counts)} tokens in {dt:.1f}s "
@@ -218,14 +228,16 @@ def bench_paged(model: str, n_tokens: int) -> int:
     )
 
 
-def bench_moe(n_tokens: int) -> int:
+def bench_moe(model: str, n_tokens: int) -> int:
     os.environ.setdefault("FEI_TPU_ROUTED_MOE", "auto")
-    return bench_decode(os.environ.get("FEI_TPU_BENCH_MODEL", "moe-2b"), n_tokens)
+    return bench_decode(model, n_tokens)
 
 
 def main() -> int:
     suite = os.environ.get("FEI_TPU_BENCH_SUITE", "decode")
-    model = os.environ.get("FEI_TPU_BENCH_MODEL", "llama3-1b")
+    model = os.environ.get(
+        "FEI_TPU_BENCH_MODEL", "moe-2b" if suite == "moe" else "llama3-1b"
+    )
     n_tokens = int(os.environ.get("FEI_TPU_BENCH_TOKENS", "256"))
     if os.environ.get("JAX_PLATFORMS"):
         # the container's sitecustomize pins the axon TPU platform and
@@ -239,7 +251,7 @@ def main() -> int:
     if suite == "paged":
         return bench_paged(model, n_tokens)
     if suite == "moe":
-        return bench_moe(n_tokens)
+        return bench_moe(model, n_tokens)
     return bench_decode(model, n_tokens)
 
 

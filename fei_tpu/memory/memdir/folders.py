@@ -21,17 +21,28 @@ from fei_tpu.utils.logging import get_logger
 log = get_logger("memory.folders")
 
 
-def _only_symlinks(path: str) -> bool:
+def _only_store_symlinks(path: str, store_base: str) -> bool:
     """True if ``path`` is a directory tree containing nothing but symlinks
-    (and directories of symlinks) — i.e. safe link-scaffolding to replace."""
+    that point INTO ``store_base`` — i.e. scaffolding this module built and
+    may safely replace. A user's own symlink farm (targets elsewhere) or any
+    real file makes it untouchable."""
+    base = os.path.realpath(store_base)
+    found_any = False
     for dirpath, dirnames, filenames in os.walk(path):
-        for fn in filenames:
-            if not os.path.islink(os.path.join(dirpath, fn)):
-                return False
+        for name in filenames + list(dirnames):
+            p = os.path.join(dirpath, name)
+            if os.path.islink(p):
+                target = os.path.realpath(p)
+                if os.path.commonpath([base, target]) != base:
+                    return False
+                found_any = True
         for d in list(dirnames):
             if os.path.islink(os.path.join(dirpath, d)):
                 dirnames.remove(d)  # don't descend through links
-    return True
+        for fn in filenames:
+            if not os.path.islink(os.path.join(dirpath, fn)):
+                return False
+    return found_any or not any(os.scandir(path))
 
 
 class MemdirFolderManager:
@@ -167,11 +178,13 @@ class MemdirFolderManager:
                     created.append(link)
                     continue
                 os.unlink(link)
-            elif os.path.isdir(link) and _only_symlinks(link):
+            elif os.path.isdir(link) and _only_store_symlinks(
+                link, self.store.base
+            ):
                 # a previous run (before this folder existed) built a real
-                # directory here to hold nested links; it contains only our
-                # symlinks, so replacing it with the folder's own link loses
-                # nothing (children are reachable through it)
+                # directory here to hold nested links; it contains only
+                # store-pointing symlinks, so replacing it with the folder's
+                # own link loses nothing (children stay reachable through it)
                 shutil.rmtree(link)
             elif os.path.exists(link):
                 raise MemoryError_(

@@ -23,12 +23,16 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-from fei_tpu.ops.quant import mm
 from fei_tpu.models.configs import ModelConfig
 from fei_tpu.ops.attention import attention
 from fei_tpu.ops.moe import moe_mlp, moe_mlp_routed
+from fei_tpu.ops.quant import mm, quantize as _quantize_w
 from fei_tpu.ops.rmsnorm import rms_norm
 from fei_tpu.ops.rope import apply_rope, compute_rope_freqs
+
+# one jitted quantizer shared by every init_params call: fuses the
+# fp32-upcast/round/clip into one kernel, compile-cached per weight shape
+_q8 = jax.jit(_quantize_w)
 
 
 class KVCache(NamedTuple):
@@ -66,9 +70,7 @@ def init_params(
             jax.random.normal(k, shape, dtype=jnp.float32) * (fan_in ** -0.5)
         ).astype(dtype)
         if quant and quantize == "int8":
-            from fei_tpu.ops.quant import quantize as q8
-
-            return jax.jit(q8)(w)
+            return _q8(w)
         return w
 
     layers: dict = {

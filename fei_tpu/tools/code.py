@@ -227,6 +227,7 @@ def _check_brackets(content: str, lang: str = "js") -> str | None:
     i, n = 0, len(content)
     prev_sig = "\n"  # last non-whitespace char outside comments/strings
     word = ""  # identifier/keyword accumulator ending at prev_sig
+    word_dotted = False  # word is a property access (obj.in) — not a keyword
     while i < n:
         c = content[i]
         if c == "\n":
@@ -263,7 +264,10 @@ def _check_brackets(content: str, lang: str = "js") -> str | None:
             continue
         elif (
             c == "/" and lang == "js"
-            and (prev_sig in _REGEX_PUNCT or word in _REGEX_KEYWORDS)
+            and (
+                prev_sig in _REGEX_PUNCT
+                or (word in _REGEX_KEYWORDS and not word_dotted)
+            )
         ):
             # regex literal — quotes/brackets inside are not code
             j, in_class = i + 1, False
@@ -325,8 +329,13 @@ def _check_brackets(content: str, lang: str = "js") -> str | None:
                 return f"unbalanced {c!r} at line {line}"
             stack.pop()
         if not c.isspace():
+            if c.isalnum() or c in "_$":
+                if not word:
+                    word_dotted = prev_sig == "."
+                word += c
+            else:
+                word = ""
             prev_sig = c
-            word = word + c if (c.isalnum() or c in "_$") else ""
         i += 1
     if stack:
         ch, ln = stack[-1]
