@@ -110,6 +110,10 @@ def restore_checkpoint(
                     shardings,
                 )
             return mgr.restore(step, args=ocp.args.StandardRestore(abstract))
-        return mgr.restore(step)
+        # structureless restore: rebuild QTensor leaves orbax flattened to
+        # dicts (with a target, jax.tree.map preserves the NamedTuple type)
+        from fei_tpu.engine.weights import _retype_qtensors
+
+        return _retype_qtensors(mgr.restore(step))
     finally:
         mgr.close()

@@ -465,11 +465,25 @@ def save_checkpoint(params: dict, path: str) -> None:
         raise CheckpointError(f"orbax save to {path} failed: {e}", cause=e)
 
 
+def _retype_qtensors(tree):
+    """Orbax round-trips NamedTuples as plain dicts; rebuild QTensor leaves
+    (recognized by their exact {q: int8, s} field pair) so quantized
+    checkpoints restore into working pytrees."""
+    if isinstance(tree, dict):
+        if (
+            set(tree.keys()) == {"q", "s"}
+            and getattr(tree["q"], "dtype", None) == jnp.int8
+        ):
+            return QTensor(q=tree["q"], s=tree["s"])
+        return {k: _retype_qtensors(v) for k, v in tree.items()}
+    return tree
+
+
 def restore_checkpoint(path: str) -> dict:
     try:
         import orbax.checkpoint as ocp
 
         ckptr = ocp.PyTreeCheckpointer()
-        return ckptr.restore(os.path.abspath(path))
+        return _retype_qtensors(ckptr.restore(os.path.abspath(path)))
     except Exception as e:
         raise CheckpointError(f"orbax restore from {path} failed: {e}", cause=e)

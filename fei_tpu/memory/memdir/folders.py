@@ -23,26 +23,24 @@ log = get_logger("memory.folders")
 
 def _only_store_symlinks(path: str, store_base: str) -> bool:
     """True if ``path`` is a directory tree containing nothing but symlinks
-    that point INTO ``store_base`` — i.e. scaffolding this module built and
-    may safely replace. A user's own symlink farm (targets elsewhere) or any
-    real file makes it untouchable."""
+    that point INTO ``store_base`` (plus empty directories) — i.e.
+    scaffolding this module built and may safely replace. A real file, or a
+    user's own symlink farm (targets elsewhere), makes it untouchable."""
     base = os.path.realpath(store_base)
-    found_any = False
     for dirpath, dirnames, filenames in os.walk(path):
-        for name in filenames + list(dirnames):
-            p = os.path.join(dirpath, name)
-            if os.path.islink(p):
-                target = os.path.realpath(p)
-                if os.path.commonpath([base, target]) != base:
-                    return False
-                found_any = True
-        for d in list(dirnames):
-            if os.path.islink(os.path.join(dirpath, d)):
-                dirnames.remove(d)  # don't descend through links
         for fn in filenames:
-            if not os.path.islink(os.path.join(dirpath, fn)):
+            p = os.path.join(dirpath, fn)
+            if not os.path.islink(p):
                 return False
-    return found_any or not any(os.scandir(path))
+            if os.path.commonpath([base, os.path.realpath(p)]) != base:
+                return False
+        for d in list(dirnames):
+            p = os.path.join(dirpath, d)
+            if os.path.islink(p):
+                dirnames.remove(d)  # don't descend through links
+                if os.path.commonpath([base, os.path.realpath(p)]) != base:
+                    return False
+    return True
 
 
 class MemdirFolderManager:

@@ -54,6 +54,27 @@ class ModelConfig:
         embed = v * h * (1 if self.tie_embeddings else 2)
         return L * (attn + mlp + norms) + embed + h
 
+    def num_active_params(self) -> int:
+        """Parameters that participate in MATMULS for one decoded token —
+        the right basis for FLOPs/token (≈ 2·active): only the top-k
+        experts run, the embedding lookup is a gather (not a matmul), and
+        the LM head is one h×v matmul whether tied or not."""
+        h, i, v, L = (
+            self.hidden_size, self.intermediate_size, self.vocab_size,
+            self.num_layers,
+        )
+        d = self.head_dim_
+        attn = (
+            h * (self.num_heads * d)
+            + 2 * h * (self.num_kv_heads * d)
+            + (self.num_heads * d) * h
+        )
+        if self.is_moe:
+            mlp = self.num_experts_per_tok * 3 * h * i + h * self.num_experts
+        else:
+            mlp = 3 * h * i
+        return L * (attn + mlp) + v * h
+
 
 # Shapes follow the published architecture cards for each family. These are
 # architectural constants (layer/head/dim counts), not code from the reference

@@ -32,14 +32,20 @@ from fei_tpu.ops.moe import moe_mlp
 from fei_tpu.ops.quant import mm
 from fei_tpu.ops.rmsnorm import rms_norm
 from fei_tpu.ops.rope import apply_rope, compute_rope_freqs
-from fei_tpu.parallel.ring import _ring_attention_shard
+from fei_tpu.parallel.ring import _ring_attention_shard, _ulysses_shard
 
 
-def _prefill_shard(x, layers, cos, sin, *, cfg: ModelConfig, axis_name: str):
+def _prefill_shard(
+    x, layers, cos, sin, *, cfg: ModelConfig, axis_name: str,
+    attend: str = "ring",
+):
     """Per-device body: full model over the local sequence chunk.
 
     x: [B, C, H] local embeddings. Returns (x_out, k_chunks, v_chunks)
-    with k/v stacked per layer: [L, B, C, K, D].
+    with k/v stacked per layer: [L, B, C, K, D]. ``attend`` picks the
+    sequence-parallel attention: "ring" (KV blocks rotate over ppermute —
+    O(T/n) attention memory) or "ulysses" (head↔seq all_to_all — full-T
+    local attention over a head slice; needs H and K divisible by n).
     """
     B, C, H = x.shape
     K, d, Hq = cfg.num_kv_heads, cfg.head_dim_, cfg.num_heads
@@ -55,9 +61,14 @@ def _prefill_shard(x, layers, cos, sin, *, cfg: ModelConfig, axis_name: str):
         q = apply_rope(q, cos, sin, positions)
         k = apply_rope(k, cos, sin, positions)
 
-        attn = _ring_attention_shard(
-            q, k, v, axis_name=axis_name, scale=d ** -0.5
-        )
+        if attend == "ulysses":
+            attn = _ulysses_shard(
+                q, k, v, axis_name=axis_name, scale=d ** -0.5
+            )
+        else:
+            attn = _ring_attention_shard(
+                q, k, v, axis_name=axis_name, scale=d ** -0.5
+            )
         x = x + mm(attn.reshape(B, C, Hq * d), lp["wo"])
 
         y = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
@@ -82,20 +93,33 @@ def prefill_ring(
     mesh: Mesh,
     max_seq_len: int | None = None,
     axis_name: str = "sp",
+    attend: str = "ring",
 ) -> tuple[jnp.ndarray, KVCache]:
     """Sequence-parallel prefill. Returns (last-token logits [B, V] fp32,
-    dense KVCache with length = T, sized ``max_seq_len`` or T)."""
+    dense KVCache with length = T, sized ``max_seq_len`` or T).
+    ``attend="ulysses"`` swaps ring rotation for the head↔seq all_to_all
+    formulation (SURVEY §2.4 Ulysses row) — same contract, different
+    ICI traffic pattern (better when T/n >> H/n·D)."""
     B, T = tokens.shape
     n = mesh.shape[axis_name]
+    if attend not in ("ring", "ulysses"):
+        raise ValueError(f"unknown attend mode {attend!r} (ring | ulysses)")
     if T % n:
         raise ValueError(f"prompt length {T} must divide sp axis {n}")
+    if attend == "ulysses" and (cfg.num_heads % n or cfg.num_kv_heads % n):
+        raise ValueError(
+            f"ulysses prefill needs heads divisible by sp={n} "
+            f"(H={cfg.num_heads}, K={cfg.num_kv_heads})"
+        )
 
     dtype = params["embed"].dtype
     cos, sin = compute_rope_freqs(cfg.head_dim_, T, cfg.rope_theta)
     x = params["embed"][tokens].astype(dtype)  # [B, T, H] (sequence-sharded in)
 
     fn = jax.shard_map(
-        functools.partial(_prefill_shard, cfg=cfg, axis_name=axis_name),
+        functools.partial(
+            _prefill_shard, cfg=cfg, axis_name=axis_name, attend=attend
+        ),
         mesh=mesh,
         in_specs=(P(None, axis_name), P(), P(), P()),
         out_specs=(

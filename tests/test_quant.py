@@ -221,6 +221,28 @@ class TestQuantizedServing:
         assert logits.shape[-1] == cfg.vocab_size
 
 
+class TestQuantizedCheckpoint:
+    def test_orbax_roundtrip_restores_qtensors(self, tmp_path):
+        """Orbax flattens NamedTuples to dicts; restore must rebuild
+        QTensor leaves so a quantized checkpoint decodes again."""
+        from fei_tpu.engine.weights import restore_checkpoint, save_checkpoint
+        from fei_tpu.models.llama import KVCache, forward
+
+        cfg = get_model_config("tiny")
+        params = init_params(
+            cfg, jax.random.PRNGKey(0), dtype=jnp.float32, quantize="int8"
+        )
+        save_checkpoint(params, str(tmp_path / "ck"))
+        back = restore_checkpoint(str(tmp_path / "ck"))
+        assert isinstance(back["layers"]["wq"], QTensor)
+        tokens = jnp.array([[1, 2, 3]], jnp.int32)
+        want, _ = forward(params, cfg, tokens, KVCache.create(cfg, 1, 8, jnp.float32))
+        got, _ = forward(back, cfg, tokens, KVCache.create(cfg, 1, 8, jnp.float32))
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=1e-6, atol=1e-6
+        )
+
+
 class TestQuantizedSharding:
     def test_tp_sharded_qtensor(self):
         """QTensor leaves shard: int8 along the weight spec, scale along the
