@@ -99,6 +99,7 @@ class InferenceEngine:
         paged: bool = False,
         page_size: int = 64,
         num_pages: int | None = None,
+        kv_quant: str | None = None,
     ):
         self.cfg = model_cfg
         self.params = params
@@ -110,6 +111,15 @@ class InferenceEngine:
         self.paged = paged
         self.page_size = page_size
         self.num_pages = num_pages  # None: worst case for batch_size seqs
+        # "int8": paged pools store int8 + per-slot scales (half the KV HBM)
+        if kv_quant not in (None, "int8"):
+            raise EngineError(f"unsupported kv_quant mode: {kv_quant!r}")
+        if kv_quant and not paged:
+            raise EngineError(
+                "kv_quant requires paged=True (the contiguous KVCache path "
+                "has no quantized variant)"
+            )
+        self.kv_quant = kv_quant
         self._pool = None  # lazy PagedKVCache page pool
         self._allocator = None
         # the scheduler object is created eagerly (it is cheap — no device
@@ -141,6 +151,7 @@ class InferenceEngine:
         page_size: int = 64,
         num_pages: int | None = None,
         quantize: str | None = None,
+        kv_quant: str | None = None,
         **overrides,
     ) -> "InferenceEngine":
         """``quantize="int8"`` converts the big linear weights to weight-only
@@ -168,6 +179,7 @@ class InferenceEngine:
             cfg, params, tok,
             max_seq_len=max_seq_len, batch_size=batch_size, dtype=dtype,
             paged=paged, page_size=page_size, num_pages=num_pages,
+            kv_quant=kv_quant,
         )
         if mesh is not None:
             from fei_tpu.parallel.sharding import shard_engine
@@ -427,6 +439,7 @@ class InferenceEngine:
             self._pool = PagedKVCache.create(
                 self.cfg, num_pages, self.batch_size, table_width,
                 page_size=self.page_size, dtype=self.dtype,
+                kv_quant=self.kv_quant,
             )
         if self._allocator is None:
             self._allocator = PageAllocator(num_pages, self.page_size)

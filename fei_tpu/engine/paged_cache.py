@@ -32,14 +32,26 @@ from fei_tpu.utils.errors import EngineError
 
 
 class PagedKVCache(NamedTuple):
-    k_pages: jnp.ndarray  # [L, P, K, ps, D]
+    """Page pool + block tables. With ``kv_quant="int8"`` the pools store
+    int8 with per-slot (per-token, per-head) fp32 scales — KV bytes halve,
+    so a pool holds ~2x the conversation tokens (the serving bottleneck for
+    the agent task loop). Scales are laid out [L, P, K, 1, ps] so the
+    kernel's scale tile is lane-oriented like its score tile."""
+
+    k_pages: jnp.ndarray  # [L, P, K, ps, D] (bf16, or int8 when quantized)
     v_pages: jnp.ndarray  # [L, P, K, ps, D]
     block_table: jnp.ndarray  # [B, max_pages] int32
     lengths: jnp.ndarray  # [B] int32
+    k_scales: jnp.ndarray | None = None  # [L, P, K, 1, ps] fp32 (int8 mode)
+    v_scales: jnp.ndarray | None = None
 
     @property
     def page_size(self) -> int:
         return self.k_pages.shape[3]
+
+    @property
+    def quantized(self) -> bool:
+        return self.k_scales is not None
 
     @classmethod
     def create(
@@ -50,14 +62,37 @@ class PagedKVCache(NamedTuple):
         max_pages_per_seq: int,
         page_size: int = 64,
         dtype=jnp.bfloat16,
+        kv_quant: str | None = None,
     ) -> "PagedKVCache":
-        shape = (cfg.num_layers, num_pages, cfg.num_kv_heads, page_size, cfg.head_dim_)
+        if kv_quant not in (None, "int8"):
+            raise EngineError(f"unsupported kv_quant mode: {kv_quant!r}")
+        L, K, D = cfg.num_layers, cfg.num_kv_heads, cfg.head_dim_
+        shape = (L, num_pages, K, page_size, D)
+        pool_dtype = jnp.int8 if kv_quant == "int8" else dtype
+        # two distinct arrays: a shared buffer would be donated twice when
+        # the pool threads through a donating dispatch
+        def scales():
+            if kv_quant != "int8":
+                return None
+            return jnp.ones((L, num_pages, K, 1, page_size), dtype=jnp.float32)
+
         return cls(
-            k_pages=jnp.zeros(shape, dtype=dtype),
-            v_pages=jnp.zeros(shape, dtype=dtype),
+            k_pages=jnp.zeros(shape, dtype=pool_dtype),
+            v_pages=jnp.zeros(shape, dtype=pool_dtype),
             block_table=jnp.zeros((batch, max_pages_per_seq), dtype=jnp.int32),
             lengths=jnp.zeros((batch,), dtype=jnp.int32),
+            k_scales=scales(),
+            v_scales=scales(),
         )
+
+
+def quant_kv_rows(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Symmetric int8 over the last (head_dim) axis: per-token, per-head
+    scales. Returns (int8 values, fp32 scales with the D axis dropped)."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    s = jnp.where(amax == 0.0, 1.0, amax / 127.0)
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / s), -127, 127).astype(jnp.int8)
+    return q, s[..., 0]
 
 
 class PageAllocator:
@@ -135,46 +170,6 @@ def build_block_table(
     return jnp.asarray(rows, dtype=jnp.int32)
 
 
-def dense_to_pages(
-    paged: PagedKVCache,
-    k_dense: jnp.ndarray,  # [L, B, S, K, D] (contiguous prefill cache)
-    v_dense: jnp.ndarray,
-    lengths: jnp.ndarray,  # [B] true prompt lengths
-    start_pages: jnp.ndarray,  # [B] first page of each seq's contiguous run
-) -> PagedKVCache:
-    """Copy a dense prefill cache into the page pool.
-
-    Each sequence's prompt pages were allocated contiguously, so the copy is
-    a reshape + one dynamic_update_slice per sequence (no per-token scatter).
-    Rounds each sequence up to whole pages; the tail garbage is masked by
-    ``lengths`` in the kernel. jit-friendly (the engine jits this with the
-    pool donated, so prefill never holds two copies of the pool in HBM).
-    """
-    L, B, S, K, D = k_dense.shape
-    ps = paged.page_size
-    if S % ps:
-        pad = ps - S % ps
-        k_dense = jnp.pad(k_dense, ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0)))
-        v_dense = jnp.pad(v_dense, ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0)))
-        S += pad
-    n = S // ps
-
-    # [L, B, n, ps, K, D] -> [B, L, n, K, ps, D]
-    def to_pages(dense):
-        x = dense.reshape(L, B, n, ps, K, D)
-        return jnp.transpose(x, (1, 0, 2, 4, 3, 5))
-
-    kp, vp = to_pages(k_dense), to_pages(v_dense)
-    k_pool, v_pool = paged.k_pages, paged.v_pages
-    for b in range(B):
-        at = (0, start_pages[b], 0, 0, 0)
-        k_pool = jax.lax.dynamic_update_slice(k_pool, kp[b].astype(k_pool.dtype), at)
-        v_pool = jax.lax.dynamic_update_slice(v_pool, vp[b].astype(v_pool.dtype), at)
-    return paged._replace(
-        k_pages=k_pool, v_pages=v_pool, lengths=lengths.astype(jnp.int32)
-    )
-
-
 def write_token_kv(
     k_pages: jnp.ndarray,  # [P, K, ps, D] one layer's pool
     v_pages: jnp.ndarray,
@@ -182,18 +177,42 @@ def write_token_kv(
     v_new: jnp.ndarray,
     block_table: jnp.ndarray,  # [B, max_pages]
     lengths: jnp.ndarray,  # [B] position being written
-) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """Scatter one decode token's K/V into each sequence's current page."""
+    k_scales: jnp.ndarray | None = None,  # [P, K, 1, ps] (int8 pools)
+    v_scales: jnp.ndarray | None = None,
+):
+    """Scatter one decode token's K/V into each sequence's current page.
+
+    Returns (k_pages, v_pages) for bf16 pools, or
+    (k_pages, v_pages, k_scales, v_scales) when the pool is int8: the new
+    token quantizes per (sequence, head) over D — per-slot scales, so no
+    other slot is ever re-read or re-scaled.
+    """
     ps = k_pages.shape[2]
     B = k_new.shape[0]
     page_slot = lengths // ps
     offset = lengths % ps
+    quantized = k_scales is not None
+    if quantized:
+        kq, ks = quant_kv_rows(k_new)  # [B, K, D] int8, [B, K]
+        vq, vs = quant_kv_rows(v_new)
+        k_new, v_new = kq, vq
     for b in range(B):  # B is static and small (decode batch)
         page = block_table[b, page_slot[b]]
         k_upd = k_new[b][None, :, None, :].astype(k_pages.dtype)  # [1, K, 1, D]
         v_upd = v_new[b][None, :, None, :].astype(v_pages.dtype)
         k_pages = jax.lax.dynamic_update_slice(k_pages, k_upd, (page, 0, offset[b], 0))
         v_pages = jax.lax.dynamic_update_slice(v_pages, v_upd, (page, 0, offset[b], 0))
+        if quantized:
+            ks_upd = ks[b][None, :, None, None]  # [1, K, 1, 1]
+            vs_upd = vs[b][None, :, None, None]
+            k_scales = jax.lax.dynamic_update_slice(
+                k_scales, ks_upd, (page, 0, 0, offset[b])
+            )
+            v_scales = jax.lax.dynamic_update_slice(
+                v_scales, vs_upd, (page, 0, 0, offset[b])
+            )
+    if quantized:
+        return k_pages, v_pages, k_scales, v_scales
     return k_pages, v_pages
 
 
@@ -203,8 +222,11 @@ def paged_attention_reference(
     v_pages: jnp.ndarray,
     block_table: jnp.ndarray,  # [B, max_pages]
     lengths: jnp.ndarray,  # [B]
+    k_scales: jnp.ndarray | None = None,  # [P, K, 1, ps]
+    v_scales: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
-    """Gather-based XLA oracle for the Pallas paged kernel (tests)."""
+    """Gather-based XLA oracle for the Pallas paged kernel (tests).
+    int8 pools dequantize in the gathered view."""
     B, H, D = q.shape
     P, K, ps, _ = k_pages.shape
     max_pages = block_table.shape[1]
@@ -212,7 +234,14 @@ def paged_attention_reference(
     # gather each sequence's pages into a contiguous [B, S, K, D] view
     kg = k_pages[block_table]  # [B, max_pages, K, ps, D]
     vg = v_pages[block_table]
+    if k_scales is not None:
+        ks = jnp.moveaxis(k_scales[block_table], -1, -2)  # [B, mp, K, ps, 1]
+        vs = jnp.moveaxis(v_scales[block_table], -1, -2)
+        kg = kg.astype(jnp.float32) * ks
+        vg = vg.astype(jnp.float32) * vs
     kc = jnp.moveaxis(kg, 2, 3).reshape(B, S, K, D)
     vc = jnp.moveaxis(vg, 2, 3).reshape(B, S, K, D)
     positions = (lengths - 1)[:, None]
-    return attention(q[:, None], kc, vc, positions, lengths)[:, 0]
+    return attention(
+        q[:, None], kc.astype(q.dtype), vc.astype(q.dtype), positions, lengths
+    )[:, 0]

@@ -402,6 +402,13 @@ class PagedScheduler:
                 L, _, S, K, D = k_dense.shape
                 need = n_pages * ps
 
+                k_scl = v_scl = None
+                if pool.quantized:
+                    from fei_tpu.engine.paged_cache import quant_kv_rows
+
+                    k_dense, ks = quant_kv_rows(k_dense)  # int8 + [L,1,S,K]
+                    v_dense, vs = quant_kv_rows(v_dense)
+
                 def pagesof(x):
                     if S >= need:
                         x = x[:, :, :need]
@@ -413,8 +420,20 @@ class PagedScheduler:
                     x = x.reshape(L, n_pages, ps, K, D)
                     return jnp.transpose(x, (1, 0, 3, 2, 4))
 
+                def scalesof(s):
+                    if S >= need:
+                        s = s[:, :, :need]
+                    else:
+                        s = jnp.pad(s, ((0, 0), (0, 0), (0, need - S), (0, 0)))
+                    # [L, 1, n*ps, K] -> [n, L, K, 1, ps]
+                    s = s.reshape(L, n_pages, ps, K)
+                    return jnp.transpose(s, (1, 0, 3, 2))[:, :, :, None, :]
+
+                if pool.quantized:
+                    k_scl, v_scl = scalesof(ks), scalesof(vs)
                 kp, vp = pagesof(k_dense), pagesof(v_dense)
                 k_pool, v_pool = pool.k_pages, pool.v_pages
+                k_spool, v_spool = pool.k_scales, pool.v_scales
                 for i in range(n_pages):
                     at = (0, page_ids[i], 0, 0, 0)
                     k_pool = jax.lax.dynamic_update_slice(
@@ -423,6 +442,13 @@ class PagedScheduler:
                     v_pool = jax.lax.dynamic_update_slice(
                         v_pool, vp[i][:, None].astype(v_pool.dtype), at
                     )
+                    if pool.quantized:
+                        k_spool = jax.lax.dynamic_update_slice(
+                            k_spool, k_scl[i][:, None], at
+                        )
+                        v_spool = jax.lax.dynamic_update_slice(
+                            v_spool, v_scl[i][:, None], at
+                        )
                 bt = jax.lax.dynamic_update_slice(
                     pool.block_table, row[None, :], (slot, 0)
                 )
@@ -430,7 +456,8 @@ class PagedScheduler:
                     pool.lengths, length[None], (slot,)
                 )
                 return pool._replace(
-                    k_pages=k_pool, v_pages=v_pool, block_table=bt, lengths=ln
+                    k_pages=k_pool, v_pages=v_pool, block_table=bt, lengths=ln,
+                    k_scales=k_spool, v_scales=v_spool,
                 )
 
             # only the pool is donated: the dense prefill K/V are reshaped

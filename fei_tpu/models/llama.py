@@ -284,11 +284,19 @@ def forward_paged(
     max_pos = cache.block_table.shape[1] * cache.page_size
     cos, sin = compute_rope_freqs(cfg.head_dim_, max_pos, cfg.rope_theta)
 
-    dtype = cache.k_pages.dtype
+    # activations follow the pool dtype for bf16/fp32 pools; int8 pools are
+    # storage-only — compute stays in the embedding dtype
+    kv_int8 = cache.k_scales is not None
+    dtype = params["embed"].dtype if kv_int8 else cache.k_pages.dtype
     x = params["embed"][tokens].astype(dtype)  # [B, 1, h]
 
     def body(x, layer_inputs):
-        lp, kp, vp = layer_inputs  # kp/vp: [P, K, ps, D] this layer's pool
+        # kp/vp: [P, K, ps, D] this layer's pool (+ scale pools when int8)
+        if kv_int8:
+            lp, kp, vp, ksc, vsc = layer_inputs
+        else:
+            lp, kp, vp = layer_inputs
+            ksc = vsc = None
         y = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
         q = mm(y, lp["wq"]).reshape(B, 1, Hq, d)
         k = mm(y, lp["wk"]).reshape(B, 1, K, d)
@@ -296,11 +304,17 @@ def forward_paged(
         q = apply_rope(q, cos, sin, positions)
         k = apply_rope(k, cos, sin, positions)
 
-        kp, vp = write_token_kv(
-            kp, vp, k[:, 0], v[:, 0], cache.block_table, cache.lengths
+        written = write_token_kv(
+            kp, vp, k[:, 0], v[:, 0], cache.block_table, cache.lengths,
+            k_scales=ksc, v_scales=vsc,
         )
+        if kv_int8:
+            kp, vp, ksc, vsc = written
+        else:
+            kp, vp = written
         attn = paged_attention(
-            q[:, 0], kp, vp, cache.block_table, cache.lengths + 1
+            q[:, 0], kp, vp, cache.block_table, cache.lengths + 1,
+            k_scales=ksc, v_scales=vsc,
         )  # [B, Hq, D]
         x = x + mm(attn.reshape(B, 1, Hq * d), lp["wo"])
 
@@ -310,16 +324,25 @@ def forward_paged(
         else:
             act = jax.nn.silu(mm(y, lp["w_gate"]).astype(jnp.float32)).astype(y.dtype)
             mlp_out = mm(act * mm(y, lp["w_up"]), lp["w_down"])
-        return x + mlp_out, (kp, vp)
+        out = (kp, vp, ksc, vsc) if kv_int8 else (kp, vp)
+        return x + mlp_out, out
 
-    x, (new_k, new_v) = jax.lax.scan(
-        body, x, (params["layers"], cache.k_pages, cache.v_pages)
-    )
+    if kv_int8:
+        xs = (
+            params["layers"], cache.k_pages, cache.v_pages,
+            cache.k_scales, cache.v_scales,
+        )
+        x, (new_k, new_v, new_ks, new_vs) = jax.lax.scan(body, x, xs)
+    else:
+        xs = (params["layers"], cache.k_pages, cache.v_pages)
+        x, (new_k, new_v) = jax.lax.scan(body, x, xs)
+        new_ks = new_vs = None
 
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     logits = _logits(x, params, cfg)
     new_cache = cache._replace(
-        k_pages=new_k, v_pages=new_v, lengths=cache.lengths + 1
+        k_pages=new_k, v_pages=new_v, lengths=cache.lengths + 1,
+        k_scales=new_ks, v_scales=new_vs,
     )
     return logits, new_cache
 

@@ -37,19 +37,18 @@ def _decode_kernel(
     # scalar prefetch
     block_table_ref,  # [B, max_pages] page index per (seq, slot)
     length_ref,  # [B] valid kv length per sequence
-    # blocks
-    q_ref,  # [1, 1, G, D] this kv head's query group
-    k_ref,  # [1, 1, page_size, D] one page of keys
-    v_ref,  # [1, 1, page_size, D]
-    o_ref,  # [1, 1, G, D]
-    # scratch
-    m_ref,  # [G, 1]
-    l_ref,  # [G, 1]
-    acc_ref,  # [G, D]
-    *,
+    # blocks: q [1,1,G,D], k/v [1,1,page_size,D]; int8 pools add
+    # ks/vs [1,1,1,page_size] per-slot scale rows before o [1,1,G,D]
+    *refs,
     page_size: int,
     scale: float,
+    kv_int8: bool,
 ):
+    if kv_int8:
+        q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref = refs
+        ks_ref = vs_ref = None
     b = pl.program_id(0)
     pi = pl.program_id(2)
     num_pages = pl.num_programs(2)
@@ -69,10 +68,14 @@ def _decode_kernel(
         v = v_ref[0, 0]
 
         s = jax.lax.dot_general(
-            q, k,
+            q, k.astype(q.dtype) if kv_int8 else k,
             dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale  # [G, page_size]
+        if kv_int8:
+            # dequant folds into the score row: k_slot scale is constant
+            # along the contracted D axis, so (q·k_int8)·ks == q·(k_int8·ks)
+            s = s * ks_ref[0, 0]  # [1, page_size] broadcasts over G
 
         pos = pi * page_size + jax.lax.broadcasted_iota(
             jnp.int32, s.shape, 1
@@ -85,8 +88,15 @@ def _decode_kernel(
         correction = jnp.exp(m_prev - m_new)
 
         l_ref[:] = correction * l_ref[:] + jnp.sum(p, axis=-1, keepdims=True)
+        if kv_int8:
+            # fold v's per-slot scale into p (constant along the contracted
+            # slot axis per output channel): (p·vs)·v_int8 == p·(v_int8·vs)
+            pv = (p * vs_ref[0, 0]).astype(jnp.float32)
+            v = v.astype(jnp.float32)
+        else:
+            pv = p.astype(v.dtype)
         acc_ref[:] = correction * acc_ref[:] + jax.lax.dot_general(
-            p.astype(v.dtype), v,
+            pv, v,
             dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
@@ -110,8 +120,15 @@ def paged_attention(
     lengths: jnp.ndarray,  # [B] int32 valid kv length
     scale: float | None = None,
     interpret: bool | None = None,
+    k_scales: jnp.ndarray | None = None,  # [P, K, 1, page_size] (int8 pools)
+    v_scales: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
-    """Single-token attention over a paged KV cache. Returns [B, H, D]."""
+    """Single-token attention over a paged KV cache. Returns [B, H, D].
+
+    int8 pools (``k_scales``/``v_scales`` given) dequantize inside the
+    kernel — scale rows ride the same page indirection as their pages, and
+    the per-slot scales fold into the score row / p matrix exactly.
+    """
     B, H, D = q.shape
     K, page_size = k_pages.shape[1], k_pages.shape[2]
     G = H // K
@@ -120,33 +137,42 @@ def paged_attention(
         scale = D ** -0.5
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    kv_int8 = k_scales is not None
 
     # group-major so each q tile is this kv head's (G, D) block
     qg = q.reshape(B, K, G, D)
 
     kernel = functools.partial(
-        _decode_kernel, page_size=page_size, scale=scale
+        _decode_kernel, page_size=page_size, scale=scale, kv_int8=kv_int8
     )
+
+    page_spec = pl.BlockSpec(
+        (1, 1, page_size, D),
+        lambda b, kh, pi, bt, ln: (bt[b, pi], kh, 0, 0),
+    )
+    scale_spec = pl.BlockSpec(
+        (1, 1, 1, page_size),
+        lambda b, kh, pi, bt, ln: (bt[b, pi], kh, 0, 0),
+    )
+    in_specs = [
+        pl.BlockSpec(
+            (1, 1, G, D),
+            lambda b, kh, pi, bt, ln: (b, kh, 0, 0),
+        ),
+        page_spec,
+        page_spec,
+    ]
+    args = [qg, k_pages, v_pages]
+    if kv_int8:
+        in_specs += [scale_spec, scale_spec]
+        args += [k_scales, v_scales]
 
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=(B, K, max_pages),
-            in_specs=[
-                pl.BlockSpec(
-                    (1, 1, G, D),
-                    lambda b, kh, pi, bt, ln: (b, kh, 0, 0),
-                ),
-                pl.BlockSpec(
-                    (1, 1, page_size, D),
-                    lambda b, kh, pi, bt, ln: (bt[b, pi], kh, 0, 0),
-                ),
-                pl.BlockSpec(
-                    (1, 1, page_size, D),
-                    lambda b, kh, pi, bt, ln: (bt[b, pi], kh, 0, 0),
-                ),
-            ],
+            in_specs=in_specs,
             out_specs=pl.BlockSpec(
                 (1, 1, G, D),
                 lambda b, kh, pi, bt, ln: (b, kh, 0, 0),
@@ -162,6 +188,6 @@ def paged_attention(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(block_table.astype(jnp.int32), lengths.astype(jnp.int32), qg, k_pages, v_pages)
+    )(block_table.astype(jnp.int32), lengths.astype(jnp.int32), *args)
 
     return out.reshape(B, H, D)

@@ -1,0 +1,177 @@
+"""int8 paged KV cache: per-slot scales, in-kernel dequantization.
+
+KV pages dominate serving HBM for the agent task loop (conversations grow
+without bound); int8 pools halve KV bytes so one pool holds ~2x the
+conversation tokens. These tests pin the write-path quantization, the
+kernel's folded dequant against the bf16 oracle, and the end-to-end
+scheduler path with an int8 pool.
+"""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fei_tpu.engine.paged_cache import (
+    PagedKVCache,
+    paged_attention_reference,
+    quant_kv_rows,
+    write_token_kv,
+)
+from fei_tpu.models.configs import get_model_config
+from fei_tpu.ops.pallas import paged_attention
+
+
+def _rand(key, shape):
+    return jax.random.normal(key, shape) * 0.5
+
+
+class TestQuantKVRows:
+    def test_roundtrip_bound(self):
+        x = _rand(jax.random.PRNGKey(0), (4, 2, 32))
+        q, s = quant_kv_rows(x)
+        back = q.astype(jnp.float32) * s[..., None]
+        amax = np.abs(np.asarray(x)).max(axis=-1, keepdims=True)
+        assert np.all(
+            np.abs(np.asarray(back) - np.asarray(x)) <= amax / 254 + 1e-7
+        )
+
+    def test_zero_rows_safe(self):
+        q, s = quant_kv_rows(jnp.zeros((2, 3, 8)))
+        assert not np.any(np.isnan(np.asarray(s)))
+
+
+class TestInt8PagedKernel:
+    def _setup(self, B=2, H=4, K=2, D=64, ps=16, pps=4, seed=0):
+        ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+        P = B * pps + 1
+        # build an int8 pool from random bf16-scale values
+        k_raw = _rand(ks[0], (P, K, ps, D))
+        v_raw = _rand(ks[1], (P, K, ps, D))
+        kq, ksc = quant_kv_rows(k_raw)  # [P,K,ps,D] int8, [P,K,ps]
+        vq, vsc = quant_kv_rows(v_raw)
+        ksc = ksc[:, :, None, :]  # [P, K, 1, ps]
+        vsc = vsc[:, :, None, :]
+        rng = np.random.default_rng(0)
+        table = rng.permutation(np.arange(1, P))[: B * pps].reshape(B, pps)
+        bt = jnp.asarray(table, jnp.int32)
+        q = _rand(ks[2], (B, H, D))
+        lengths = jnp.array([ps * pps - 3, 7][:B], jnp.int32)
+        return q, kq, vq, ksc, vsc, bt, lengths
+
+    def test_matches_dequant_oracle(self):
+        q, kq, vq, ksc, vsc, bt, lengths = self._setup()
+        want = paged_attention_reference(
+            q, kq, vq, bt, lengths, k_scales=ksc, v_scales=vsc
+        )
+        got = paged_attention(
+            q, kq, vq, bt, lengths, k_scales=ksc, v_scales=vsc
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), atol=3e-3
+        )
+
+    def test_int8_close_to_fp_attention(self):
+        """Quantize-dequantize error stays small end-to-end through the
+        kernel (vs attention over the unquantized values)."""
+        B, H, K, D, ps, pps = 1, 2, 2, 32, 8, 2
+        ks = jax.random.split(jax.random.PRNGKey(1), 3)
+        P = B * pps + 1
+        k_raw = _rand(ks[0], (P, K, ps, D))
+        v_raw = _rand(ks[1], (P, K, ps, D))
+        q = _rand(ks[2], (B, H, D))
+        bt = jnp.arange(1, 1 + B * pps, dtype=jnp.int32).reshape(B, pps)
+        lengths = jnp.array([13], jnp.int32)
+
+        fp = paged_attention(q, k_raw, v_raw, bt, lengths)
+        kq, ksc = quant_kv_rows(k_raw)
+        vq, vsc = quant_kv_rows(v_raw)
+        got = paged_attention(
+            q, kq, vq, bt, lengths,
+            k_scales=ksc[:, :, None, :], v_scales=vsc[:, :, None, :],
+        )
+        rel = np.abs(np.asarray(got) - np.asarray(fp)).max()
+        rel /= np.abs(np.asarray(fp)).max()
+        assert rel < 0.05, f"int8 KV relative error {rel}"
+
+
+class TestInt8WritePath:
+    def test_write_token_roundtrip(self):
+        K, ps, D, P = 2, 8, 16, 4
+        kp = jnp.zeros((P, K, ps, D), jnp.int8)
+        vp = jnp.zeros((P, K, ps, D), jnp.int8)
+        ksc = jnp.ones((P, K, 1, ps), jnp.float32)
+        vsc = jnp.ones((P, K, 1, ps), jnp.float32)
+        bt = jnp.array([[2, 3]], jnp.int32)
+        k_new = _rand(jax.random.PRNGKey(0), (1, K, D))
+        v_new = _rand(jax.random.PRNGKey(1), (1, K, D))
+        lengths = jnp.array([ps + 3], jnp.int32)  # lands in page 3, slot 3
+
+        kp, vp, ksc, vsc = write_token_kv(
+            kp, vp, k_new, v_new, bt, lengths, k_scales=ksc, v_scales=vsc
+        )
+        back = np.asarray(kp[3, :, 3, :], np.float32) * np.asarray(
+            ksc[3, :, 0, 3]
+        )[:, None]
+        amax = np.abs(np.asarray(k_new[0])).max(axis=-1, keepdims=True)
+        assert np.all(np.abs(back - np.asarray(k_new[0])) <= amax / 254 + 1e-7)
+
+
+class TestInt8Serving:
+    def test_scheduler_int8_kv(self):
+        from fei_tpu.engine import GenerationConfig, InferenceEngine
+
+        eng = InferenceEngine.from_config(
+            "tiny", tokenizer="byte", max_seq_len=64,
+            paged=True, batch_size=2, page_size=8, kv_quant="int8",
+        )
+        pool = eng._ensure_pool()
+        assert pool.k_pages.dtype == jnp.int8 and pool.quantized
+        gen = GenerationConfig(max_new_tokens=6, temperature=0.0, ignore_eos=True)
+        prompt = eng.tokenizer.encode("hello world", add_bos=True)
+        results = [None, None]
+
+        def consume(i):
+            results[i] = list(eng.scheduler.stream(prompt, gen))
+
+        threads = [threading.Thread(target=consume, args=(i,)) for i in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert all(r is not None and len(r) == 6 for r in results)
+        assert results[0] == results[1]  # greedy determinism
+
+    def test_int8_kv_tracks_bf16_kv(self):
+        """Same engine/weights, int8 vs bf16 pools: greedy streams agree on
+        a short horizon (the int8 error is far below sampling boundaries
+        for a well-scaled tiny model)."""
+        from fei_tpu.engine import GenerationConfig, InferenceEngine
+
+        gen = GenerationConfig(max_new_tokens=8, temperature=0.0, ignore_eos=True)
+        outs = {}
+        for mode in (None, "int8"):
+            eng = InferenceEngine.from_config(
+                "tiny", tokenizer="byte", max_seq_len=64,
+                paged=True, batch_size=1, page_size=8, kv_quant=mode,
+            )
+            prompt = eng.tokenizer.encode("determinism", add_bos=True)
+            outs[mode] = list(eng.scheduler.stream(prompt, gen))
+        assert len(outs["int8"]) == len(outs[None]) == 8
+        assert outs["int8"] == outs[None]
+
+    def test_pool_bytes_halved(self):
+        cfg = get_model_config("tiny")
+        bf16 = PagedKVCache.create(cfg, 16, 2, 4, page_size=8)
+        q8 = PagedKVCache.create(cfg, 16, 2, 4, page_size=8, kv_quant="int8")
+        bf16_kv = bf16.k_pages.nbytes + bf16.v_pages.nbytes
+        q8_kv = (
+            q8.k_pages.nbytes + q8.v_pages.nbytes
+            + q8.k_scales.nbytes + q8.v_scales.nbytes
+        )
+        # analytic ratio: 0.5 (int8 vs bf16) + 2/D scale overhead. tiny's
+        # D=16 gives 0.625; Llama-class D=128 gives ~0.516
+        expect = 0.5 + 2.0 / cfg.head_dim_
+        assert q8_kv <= expect * bf16_kv + 1
