@@ -43,11 +43,14 @@ def _make_engine(model: str, **kwargs):
     from fei_tpu.engine import InferenceEngine
 
     quant = os.environ.get("FEI_TPU_BENCH_QUANT") or None
-    kv_quant = os.environ.get("FEI_TPU_BENCH_KV_QUANT") or None
+    if kwargs.get("paged"):
+        # int8 KV only exists for paged pools; other suites ignore the knob
+        kwargs.setdefault(
+            "kv_quant", os.environ.get("FEI_TPU_BENCH_KV_QUANT") or None
+        )
     t0 = time.time()
     engine = InferenceEngine.from_config(
-        model, dtype=jnp.bfloat16, tokenizer="byte", quantize=quant,
-        kv_quant=kv_quant, **kwargs
+        model, dtype=jnp.bfloat16, tokenizer="byte", quantize=quant, **kwargs
     )
     from fei_tpu.ops.quant import param_bytes
 

@@ -441,6 +441,24 @@ class InferenceEngine:
                 page_size=self.page_size, dtype=self.dtype,
                 kv_quant=self.kv_quant,
             )
+            if self.mesh is not None:
+                # kv heads shard over tp (mirrors the dense cache layout);
+                # tables/lengths replicate. The paged kernel runs under
+                # shard_map on this layout (forward_paged kernel_mesh)
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                page_s = NamedSharding(self.mesh, P(None, None, "tp", None, None))
+                rep = NamedSharding(self.mesh, P())
+                self._pool = self._pool._replace(
+                    k_pages=jax.device_put(self._pool.k_pages, page_s),
+                    v_pages=jax.device_put(self._pool.v_pages, page_s),
+                    block_table=jax.device_put(self._pool.block_table, rep),
+                    lengths=jax.device_put(self._pool.lengths, rep),
+                    k_scales=None if self._pool.k_scales is None else
+                    jax.device_put(self._pool.k_scales, page_s),
+                    v_scales=None if self._pool.v_scales is None else
+                    jax.device_put(self._pool.v_scales, page_s),
+                )
         if self._allocator is None:
             self._allocator = PageAllocator(num_pages, self.page_size)
         return self._pool

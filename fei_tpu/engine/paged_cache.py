@@ -88,11 +88,13 @@ class PagedKVCache(NamedTuple):
 
 def quant_kv_rows(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Symmetric int8 over the last (head_dim) axis: per-token, per-head
-    scales. Returns (int8 values, fp32 scales with the D axis dropped)."""
-    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1, keepdims=True)
-    s = jnp.where(amax == 0.0, 1.0, amax / 127.0)
-    q = jnp.clip(jnp.round(x.astype(jnp.float32) / s), -127, 127).astype(jnp.int8)
-    return q, s[..., 0]
+    scales. Returns (int8 values, fp32 scales with the D axis dropped).
+    One quantization rule for the whole engine: delegates to
+    ops.quant.quantize (weights use contract_axis=-2, KV rows -1)."""
+    from fei_tpu.ops.quant import quantize
+
+    qt = quantize(x, contract_axis=-1)
+    return qt.q, jnp.squeeze(qt.s, axis=-1)
 
 
 class PageAllocator:

@@ -469,9 +469,12 @@ class PagedScheduler:
         key = (masked,)
         if key not in self._step_jit:
             cfg = self.engine.cfg
+            mesh = self.engine.mesh  # tp mesh: kernel runs via shard_map
 
             def step(params, pool, tokens, keys, temps, topks, topps, mask=None):
-                logits, pool = forward_paged(params, cfg, tokens, pool)
+                logits, pool = forward_paged(
+                    params, cfg, tokens, pool, kernel_mesh=mesh
+                )
                 logits = logits[:, -1, :]
                 if masked:
                     logits = jnp.where(mask, logits, -jnp.inf)

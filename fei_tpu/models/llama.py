@@ -267,6 +267,7 @@ def forward_paged(
     cache,  # PagedKVCache (engine/paged_cache.py)
     routed_moe: bool = False,
     moe_mesh=None,
+    kernel_mesh=None,
 ) -> tuple[jnp.ndarray, object]:
     """Single-token decode against a paged KV cache.
 
@@ -274,9 +275,15 @@ def forward_paged(
     (write_token_kv) and attention reads through the block table with the
     Pallas ragged paged kernel. Returns (logits [B, 1, V], updated cache
     with lengths += 1).
+
+    ``kernel_mesh``: a mesh with a tp axis — the paged kernel then runs
+    under shard_map with kv heads sharded (XLA cannot auto-partition a
+    pallas_call), making multi-chip paged serving real; everything else in
+    the layer partitions from the param/pool shardings as usual.
     """
     from fei_tpu.engine.paged_cache import write_token_kv
     from fei_tpu.ops.pallas import paged_attention
+    from fei_tpu.ops.pallas.paged_attention import paged_attention_sharded
 
     B = tokens.shape[0]
     K, d, Hq = cfg.num_kv_heads, cfg.head_dim_, cfg.num_heads
@@ -312,10 +319,16 @@ def forward_paged(
             kp, vp, ksc, vsc = written
         else:
             kp, vp = written
-        attn = paged_attention(
-            q[:, 0], kp, vp, cache.block_table, cache.lengths + 1,
-            k_scales=ksc, v_scales=vsc,
-        )  # [B, Hq, D]
+        if kernel_mesh is not None and kernel_mesh.shape.get("tp", 1) > 1:
+            attn = paged_attention_sharded(
+                q[:, 0], kp, vp, cache.block_table, cache.lengths + 1,
+                kernel_mesh, axis_name="tp", k_scales=ksc, v_scales=vsc,
+            )
+        else:
+            attn = paged_attention(
+                q[:, 0], kp, vp, cache.block_table, cache.lengths + 1,
+                k_scales=ksc, v_scales=vsc,
+            )  # [B, Hq, D]
         x = x + mm(attn.reshape(B, 1, Hq * d), lp["wo"])
 
         y = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)

@@ -191,3 +191,48 @@ def paged_attention(
     )(block_table.astype(jnp.int32), lengths.astype(jnp.int32), *args)
 
     return out.reshape(B, H, D)
+
+
+def paged_attention_sharded(
+    q: jnp.ndarray,  # [B, H, D]
+    k_pages: jnp.ndarray,  # [P, K, page_size, D] (kv-head sharded over tp)
+    v_pages: jnp.ndarray,
+    block_table: jnp.ndarray,
+    lengths: jnp.ndarray,
+    mesh,
+    axis_name: str = "tp",
+    k_scales: jnp.ndarray | None = None,
+    v_scales: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """Tensor-parallel paged attention: XLA cannot auto-partition a
+    pallas_call, so the kernel runs under shard_map with kv heads (and the
+    query head groups that attend to them) sharded over ``axis_name`` —
+    each device attends over its local slice of the page pool. Composable
+    inside an outer jit; inputs already laid out this way reshard for free.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    n = mesh.shape[axis_name]
+    K = k_pages.shape[1]
+    if K % n:
+        raise ValueError(f"kv heads {K} must divide {axis_name} axis {n}")
+    head_spec = P(None, axis_name, None)  # q/out: heads sharded
+    page_spec = P(None, axis_name, None, None)
+    in_specs = [head_spec, page_spec, page_spec, P(), P()]
+    args = [q, k_pages, v_pages, block_table, lengths]
+    if k_scales is not None:
+        in_specs += [page_spec, page_spec]
+        args += [k_scales, v_scales]
+
+    def body(q, kp, vp, bt, ln, *scales):
+        ks, vs = scales if scales else (None, None)
+        return paged_attention(q, kp, vp, bt, ln, k_scales=ks, v_scales=vs)
+
+    import jax as _jax
+
+    fn = _jax.shard_map(
+        body, mesh=mesh, in_specs=tuple(in_specs), out_specs=head_spec,
+        # the vma checker can't see through a pallas_call's output
+        check_vma=False,
+    )
+    return fn(*args)
