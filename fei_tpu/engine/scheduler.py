@@ -18,6 +18,10 @@ Design notes
 - Admission = dense bucketed prefill (one [1, bucket] forward) + per-page
   scatter of the prompt K/V into freshly allocated pages + block-table row
   update, all in one jitted program with the pool donated.
+- Prompts longer than FEI_TPU_PREFILL_CHUNK (default 256) admit in CHUNKS:
+  one compiled chunk-prefill per loop iteration against a persistent dense
+  cache, interleaved with decode steps — active streams stall at most one
+  chunk, not a whole long-prompt prefill (vLLM-style chunked prefill).
 - Each sequence keeps the SAME per-sequence PRNG chain as the single-stream
   dense path (PRNGKey(seed) → split at prefill → split per step), so a
   request decoded through the scheduler yields token-for-token what the
@@ -42,7 +46,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from fei_tpu.engine.sampling import sample_logits, sample_logits_dynamic
-from fei_tpu.models.llama import KVCache, forward_paged
+from fei_tpu.models.llama import KVCache, forward, forward_paged
 from fei_tpu.utils.errors import EngineError
 from fei_tpu.utils.logging import get_logger
 from fei_tpu.utils.metrics import METRICS
@@ -67,6 +71,7 @@ class _Seq:
     next_input: int = 0
     cancelled: bool = False
     finished: bool = False
+    prefilling: bool = False  # chunked admission in progress (no decode yet)
 
 
 class PagedScheduler:
@@ -89,7 +94,15 @@ class PagedScheduler:
         self._keys = None  # [B, 2] per-slot PRNG keys
         self._step_jit: dict = {}
         self._admit_jit: dict = {}
+        self._chunk_jit: dict = {}
         self._evict_jit = None
+        # prompts longer than this admit in chunks, one chunk per loop
+        # iteration, so active decode streams never stall longer than one
+        # chunk's prefill (vLLM-style chunked prefill)
+        import os as _os
+
+        self.prefill_chunk = int(_os.environ.get("FEI_TPU_PREFILL_CHUNK", "256"))
+        self._admitting: dict | None = None  # in-flight chunked admission
 
     # -- public API ---------------------------------------------------------
 
@@ -186,7 +199,21 @@ class PagedScheduler:
     def _admit_ready(self) -> None:
         """FIFO admission: fill free slots while the pool has pages. Head-of-
         line blocking is deliberate — it guarantees a too-big-for-now request
-        eventually runs instead of starving behind smaller latecomers."""
+        eventually runs instead of starving behind smaller latecomers.
+
+        A chunked admission in flight gets exactly one chunk of prefill per
+        call, so the caller's loop interleaves it with decode steps."""
+        if self._admitting is not None:
+            seq, slot = self._admitting["seq"], self._admitting["slot"]
+            try:
+                self._admit_chunk()
+            except BaseException as exc:  # noqa: BLE001
+                self._admitting = None
+                self.engine._allocator.free(slot)
+                self._slots[slot] = None
+                seq.finished = True
+                seq.out.put(exc)
+            return
         while True:
             with self._lock:
                 if not self._waiting:
@@ -206,8 +233,12 @@ class PagedScheduler:
                 self._slots[slot] = seq
                 seq.slot = slot
             try:
+                if len(seq.prompt_ids) > self.prefill_chunk:
+                    self._start_chunked(seq, slot)
+                    return  # one chunked admission at a time
                 self._admit(seq, slot)
             except BaseException as exc:  # noqa: BLE001
+                self._admitting = None
                 self.engine._allocator.free(slot)
                 self._slots[slot] = None
                 seq.finished = True
@@ -220,7 +251,7 @@ class PagedScheduler:
         prompt = seq.prompt_ids
         n = len(prompt)
         need = alloc.pages_needed(min(n + seq.budget, eng.max_seq_len))
-        pages = alloc.alloc(slot, need)
+        alloc.alloc(slot, need)
 
         with METRICS.span("prefill", jax_trace=True):
             from fei_tpu.engine.engine import _next_bucket
@@ -230,8 +261,101 @@ class PagedScheduler:
             last_logits, dense = eng.prefill([prompt], dense)
             last_logits.block_until_ready()
 
-        # first token sampled on the request's own key chain, exactly like
-        # the dense single-stream prologue (engine._prefill_sample)
+        self._complete_admission(seq, slot, dense, bucket, last_logits)
+
+    def _start_chunked(self, seq: _Seq, slot: int) -> None:
+        """Begin a chunked admission: pages reserved up front, prompt K/V
+        built chunk-by-chunk across loop iterations so concurrent decode
+        streams stall at most one chunk's prefill at a time."""
+        eng = self.engine
+        alloc = eng._allocator
+        n = len(seq.prompt_ids)
+        need = alloc.pages_needed(min(n + seq.budget, eng.max_seq_len))
+        alloc.alloc(slot, need)
+        seq.prefilling = True
+        from fei_tpu.engine.engine import _next_bucket
+
+        # the bucket MUST be a multiple of the chunk size: every chunk
+        # writes a full C-row slice, and a final chunk extending past the
+        # cache would be silently clamped by dynamic_update_slice —
+        # corrupting earlier K/V positions instead of erroring
+        C = self.prefill_chunk
+        bucket = -(-_next_bucket(n) // C) * C
+        dense = KVCache.create(eng.cfg, 1, bucket, dtype=eng.dtype)
+        self._admitting = {
+            "seq": seq, "slot": slot, "dense": dense,
+            "pos": 0, "bucket": bucket,
+        }
+        self._admit_chunk()
+
+    def _admit_chunk(self) -> None:
+        """Run ONE prefill chunk of the in-flight chunked admission."""
+        st = self._admitting
+        seq = st["seq"]
+        if seq.finished:  # reaped by _reap_cancelled already
+            self._admitting = None
+            return
+        if seq.cancelled:
+            self._admitting = None
+            self._finish(seq)
+            return
+        eng = self.engine
+        C = self.prefill_chunk
+        prompt = seq.prompt_ids
+        n, lo = len(prompt), st["pos"]
+        hi = min(lo + C, n)
+        toks = np.zeros((1, C), dtype=np.int32)
+        toks[0, : hi - lo] = prompt[lo:hi]
+        with METRICS.span("prefill_chunk", jax_trace=True):
+            fn = self._chunk_fn(C, st["bucket"])
+            last_logits, st["dense"] = fn(
+                eng.params, st["dense"], jnp.asarray(toks), jnp.int32(hi - lo)
+            )
+            last_logits.block_until_ready()
+        st["pos"] = hi
+        if hi < n:
+            return  # more chunks; decode steps interleave
+        self._admitting = None
+        self._complete_admission(seq, st["slot"], st["dense"], st["bucket"], last_logits)
+
+    def _chunk_fn(self, C: int, bucket: int):
+        """Compiled one-chunk prefill against a persistent dense cache
+        (donated): forward over [1, C] tokens, cache length corrected to
+        the chunk's true token count (padding K/V beyond it is overwritten
+        by the next chunk and masked by attention). Only the chunk's last
+        valid position goes through the LM head — intermediate chunks never
+        pay the [C, V] logits matmul."""
+        key = (C, bucket)
+        if key not in self._chunk_jit:
+            cfg = self.engine.cfg
+            routed = self.engine.mesh is None
+            moe_mesh = self.engine._moe_mesh()
+            from fei_tpu.models.llama import _logits
+
+            def chunk(params, dense, toks, true_len):
+                hidden, cache2 = forward(
+                    params, cfg, toks, dense,
+                    routed_moe=routed, moe_mesh=moe_mesh, lm_head=False,
+                )
+                cache2 = cache2._replace(length=dense.length + true_len)
+                h_last = jax.lax.dynamic_slice_in_dim(
+                    hidden, true_len - 1, 1, axis=1
+                )  # [1, 1, H]
+                return _logits(h_last, params, cfg)[:, 0], cache2
+
+            self._chunk_jit[key] = jax.jit(chunk, donate_argnums=(1,))
+        return self._chunk_jit[key]
+
+    def _complete_admission(
+        self, seq: _Seq, slot: int, dense, bucket: int, last_logits
+    ) -> None:
+        """Shared admission tail: sample the first token on the request's
+        own key chain (exactly like the dense single-stream prologue,
+        engine._prefill_sample), scatter prompt K/V into pages, and arm the
+        slot for decode."""
+        eng = self.engine
+        alloc = eng._allocator
+        n = len(seq.prompt_ids)
         mask = self._host_mask(seq, first=True)
         if mask is not None:
             last_logits = jnp.where(jnp.asarray(mask)[None, :], last_logits, -jnp.inf)
@@ -246,6 +370,7 @@ class PagedScheduler:
         )
 
         # prompt K/V → pages + block-table row + length, pool donated
+        pages = alloc.pages_for(slot)
         n_prompt_pages = alloc.pages_needed(n)
         width = self._pool.block_table.shape[1]
         row = np.zeros((width,), dtype=np.int32)
@@ -258,6 +383,7 @@ class PagedScheduler:
             jnp.int32(slot), jnp.int32(n),
         )
         self._keys = self._keys.at[slot].set(rng)
+        seq.prefilling = False
 
         if seq.budget <= 0 or tok0 in seq.stops:
             self._finish(seq)
@@ -276,7 +402,7 @@ class PagedScheduler:
         # the other in-flight sequences or the pool
         masks: dict[int, np.ndarray] = {}
         for b, s in list(enumerate(self._slots)):
-            if s is None or s.mask_fn is None:
+            if s is None or s.prefilling or s.mask_fn is None:
                 continue
             try:
                 m = self._host_mask(s)
@@ -286,7 +412,9 @@ class PagedScheduler:
                 continue
             if m is not None:
                 masks[b] = m
-        if not any(self._slots):
+        # decode only runs for armed slots; chunk-prefilling slots write to
+        # the null page (their table row is still zeroed) and are skipped
+        if not any(s is not None and not s.prefilling for s in self._slots):
             return
 
         tokens = np.zeros((B, 1), dtype=np.int32)
@@ -296,7 +424,7 @@ class PagedScheduler:
         masked = bool(masks)
         mask = np.ones((B, V), dtype=bool) if masked else None
         for b, s in enumerate(self._slots):
-            if s is None:
+            if s is None or s.prefilling:
                 continue
             tokens[b, 0] = s.next_input
             temps[b] = s.gen.temperature
@@ -315,7 +443,7 @@ class PagedScheduler:
             toks = np.asarray(nxt)  # host sync inside the span
 
         for b, s in list(enumerate(self._slots)):
-            if s is None:
+            if s is None or s.prefilling:
                 continue
             t = int(toks[b])
             if t in s.stops:

@@ -229,11 +229,15 @@ def forward(
     cache: KVCache,
     routed_moe: bool = False,
     moe_mesh=None,
+    lm_head: bool = True,
 ) -> tuple[jnp.ndarray, KVCache]:
     """Run T tokens through the model against the cache.
 
     Serves prefill (T = prompt chunk) and decode (T = 1) identically.
     Returns (logits [B, T, V], updated cache with length += T).
+    ``lm_head=False`` returns final-norm hidden states [B, T, H] instead of
+    logits — chunked prefill only needs one position's logits, so callers
+    skip the [T, V] head matmul and project the position they want.
     """
     B, T = tokens.shape
     positions = cache.length[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
@@ -255,9 +259,10 @@ def forward(
     )
 
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
-    logits = _logits(x, params, cfg)
     new_cache = KVCache(k=new_k, v=new_v, length=cache.length + T)
-    return logits, new_cache
+    if not lm_head:
+        return x, new_cache
+    return _logits(x, params, cfg), new_cache
 
 
 def forward_paged(

@@ -228,9 +228,7 @@ def paged_attention_sharded(
         ks, vs = scales if scales else (None, None)
         return paged_attention(q, kp, vp, bt, ln, k_scales=ks, v_scales=vs)
 
-    import jax as _jax
-
-    fn = _jax.shard_map(
+    fn = jax.shard_map(
         body, mesh=mesh, in_specs=tuple(in_specs), out_specs=head_spec,
         # the vma checker can't see through a pallas_call's output
         check_vma=False,

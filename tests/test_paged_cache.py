@@ -277,3 +277,83 @@ class TestContinuousBatching:
                 )
             )
         assert got == want
+
+
+class TestChunkedPrefill:
+    """Long prompts admit chunk-by-chunk so concurrent decode streams never
+    stall longer than one chunk's prefill (vLLM-style chunked prefill)."""
+
+    def _engine(self, monkeypatch, chunk):
+        monkeypatch.setenv("FEI_TPU_PREFILL_CHUNK", str(chunk))
+        return InferenceEngine.from_config(
+            "tiny", paged=True, page_size=16, batch_size=2,
+            dtype=jnp.float32, seed=0, tokenizer="byte",
+            max_seq_len=256, num_layers=2,
+        )
+
+    def test_chunked_matches_unchunked(self, monkeypatch):
+        long_text = "the quick brown fox jumps over the lazy dog " * 3
+        gen = GenerationConfig(max_new_tokens=8, temperature=0.0, ignore_eos=True)
+
+        big = self._engine(monkeypatch, 4096)  # whole prompt in one go
+        prompt = big.tokenizer.encode(long_text, add_bos=True)
+        assert len(prompt) > 64
+        want = list(big.scheduler.stream(prompt, gen))
+
+        small = self._engine(monkeypatch, 16)  # many chunks, incl. a ragged tail
+        got = list(small.scheduler.stream(prompt, gen))
+        assert got == want
+
+    def test_non_power_of_two_chunk(self, monkeypatch):
+        """A chunk size that doesn't divide the power-of-two bucket: the
+        dense cache must round up to a chunk multiple — otherwise the final
+        chunk's dynamic_update_slice would clamp and silently corrupt
+        earlier K/V positions."""
+        gen = GenerationConfig(max_new_tokens=8, temperature=0.0, ignore_eos=True)
+        big = self._engine(monkeypatch, 4096)
+        prompt = big.tokenizer.encode("z" * 100, add_bos=True)  # n=101
+        want = list(big.scheduler.stream(prompt, gen))
+        odd = self._engine(monkeypatch, 24)  # bucket 128 is NOT a multiple
+        got = list(odd.scheduler.stream(prompt, gen))
+        assert got == want
+
+    def test_decode_interleaves_with_chunked_admission(self, monkeypatch):
+        """A short stream admitted first keeps decoding while a long prompt
+        chunk-prefills; both outputs match their solo runs."""
+        eng = self._engine(monkeypatch, 16)
+        gen = GenerationConfig(max_new_tokens=12, temperature=0.0, ignore_eos=True)
+        short = eng.tokenizer.encode("short prompt", add_bos=True)
+        long = eng.tokenizer.encode("x" * 150, add_bos=True)
+
+        want_short = list(eng.scheduler.stream(short, gen))
+        want_long = list(eng.scheduler.stream(long, gen))
+
+        import concurrent.futures as cf
+
+        with cf.ThreadPoolExecutor(2) as ex:
+            f_short = ex.submit(lambda: list(eng.scheduler.stream(short, gen)))
+            f_long = ex.submit(lambda: list(eng.scheduler.stream(long, gen)))
+            assert f_short.result(timeout=120) == want_short
+            assert f_long.result(timeout=120) == want_long
+        assert eng._allocator.free_pages == eng._allocator.num_pages - 1
+
+    def test_cancel_mid_chunked_prefill(self, monkeypatch):
+        """Closing a stream while its prompt is still chunk-prefilling frees
+        the slot and pages; the engine keeps serving."""
+        import time
+
+        eng = self._engine(monkeypatch, 16)
+        gen = GenerationConfig(max_new_tokens=4, temperature=0.0, ignore_eos=True)
+        long = eng.tokenizer.encode("y" * 200, add_bos=True)
+        seq = eng.scheduler.submit(long, gen)
+        time.sleep(0.05)  # let a chunk or two run
+        eng.scheduler.cancel(seq)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if eng._allocator.free_pages == eng._allocator.num_pages - 1:
+                break
+            time.sleep(0.05)
+        assert eng._allocator.free_pages == eng._allocator.num_pages - 1
+        # still serves afterwards
+        out = list(eng.scheduler.stream(eng.tokenizer.encode("ok"), gen))
+        assert len(out) == 4
