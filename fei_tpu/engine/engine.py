@@ -100,6 +100,7 @@ class InferenceEngine:
         page_size: int = 64,
         num_pages: int | None = None,
         kv_quant: str | None = None,
+        prefix_cache: bool = False,
     ):
         self.cfg = model_cfg
         self.params = params
@@ -120,6 +121,9 @@ class InferenceEngine:
                 "has no quantized variant)"
             )
         self.kv_quant = kv_quant
+        # opt-in (vLLM-style): shared page-aligned prompt prefixes are
+        # cached and reused across requests by the scheduler
+        self.prefix_cache = prefix_cache
         self._pool = None  # lazy PagedKVCache page pool
         self._allocator = None
         # the scheduler object is created eagerly (it is cheap — no device
@@ -152,6 +156,7 @@ class InferenceEngine:
         num_pages: int | None = None,
         quantize: str | None = None,
         kv_quant: str | None = None,
+        prefix_cache: bool = False,
         **overrides,
     ) -> "InferenceEngine":
         """``quantize="int8"`` converts the big linear weights to weight-only
@@ -179,7 +184,7 @@ class InferenceEngine:
             cfg, params, tok,
             max_seq_len=max_seq_len, batch_size=batch_size, dtype=dtype,
             paged=paged, page_size=page_size, num_pages=num_pages,
-            kv_quant=kv_quant,
+            kv_quant=kv_quant, prefix_cache=prefix_cache,
         )
         if mesh is not None:
             from fei_tpu.parallel.sharding import shard_engine

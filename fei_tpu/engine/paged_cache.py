@@ -25,6 +25,7 @@ from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from fei_tpu.models.configs import ModelConfig
 from fei_tpu.ops.attention import attention
@@ -98,10 +99,13 @@ def quant_kv_rows(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
 
 
 class PageAllocator:
-    """Free-list page allocator over a pool of ``num_pages`` pages.
+    """Refcounting free-list page allocator over a pool of ``num_pages``.
 
     Page 0 is reserved as the null page (block-table padding points there),
-    mirroring the null-block convention of paged-attention servers.
+    mirroring the null-block convention of paged-attention servers. Pages
+    are refcounted so prefix caching can SHARE full prompt-prefix pages
+    across sequences (and with the PrefixCache registry): a page returns to
+    the free list only when its last reference drops.
     """
 
     def __init__(self, num_pages: int, page_size: int):
@@ -109,18 +113,22 @@ class PageAllocator:
         self.page_size = page_size
         self._free = list(range(num_pages - 1, 0, -1))  # pop() yields 1, 2, …
         self._owned: dict[int, list[int]] = {}
+        self._refs: dict[int, int] = {}
 
     @property
     def free_pages(self) -> int:
         return len(self._free)
 
+    def refcount(self, page: int) -> int:
+        return self._refs.get(page, 0)
+
     def pages_for(self, seq_id: int) -> list[int]:
         return list(self._owned.get(seq_id, []))
 
     def alloc(self, seq_id: int, n: int, contiguous: bool = False) -> list[int]:
-        """Allocate n pages for a sequence. ``contiguous=True`` requires (and
-        returns) an ascending run — used at prefill so the dense→paged copy
-        is one dynamic_update_slice per sequence."""
+        """Allocate n fresh pages for a sequence. ``contiguous=True``
+        requires (and returns) an ascending run — used at prefill so the
+        dense→paged copy is one dynamic_update_slice per sequence."""
         if n > len(self._free):
             raise EngineError(
                 f"paged KV pool exhausted: need {n} pages, {len(self._free)} free"
@@ -136,8 +144,33 @@ class PageAllocator:
             got = run
         else:
             got = [self._free.pop() for _ in range(n)]
+        for p in got:
+            self._refs[p] = 1
         self._owned.setdefault(seq_id, []).extend(got)
         return got
+
+    def share(self, seq_id: int, pages: list[int]) -> None:
+        """Add existing (cached-prefix) pages to a sequence: refcount++
+        each; they precede any later alloc()'d pages in pages_for order."""
+        for p in pages:
+            if self._refs.get(p, 0) <= 0:
+                raise EngineError(f"cannot share unreferenced page {p}")
+            self._refs[p] += 1
+        self._owned.setdefault(seq_id, []).extend(pages)
+
+    def take_ref(self, pages: list[int]) -> None:
+        """Registry-held references (prefix cache entries)."""
+        for p in pages:
+            if self._refs.get(p, 0) <= 0:
+                raise EngineError(f"cannot reference dead page {p}")
+            self._refs[p] += 1
+
+    def drop_ref(self, pages: list[int]) -> None:
+        for p in pages:
+            self._refs[p] -= 1
+            if self._refs[p] <= 0:
+                del self._refs[p]
+                self._free.append(p)
 
     def _find_run(self, n: int) -> list[int] | None:
         free = sorted(self._free)
@@ -152,10 +185,91 @@ class PageAllocator:
         return None
 
     def free(self, seq_id: int) -> None:
-        self._free.extend(reversed(self._owned.pop(seq_id, [])))
+        self.drop_ref(list(reversed(self._owned.pop(seq_id, []))))
 
     def pages_needed(self, tokens: int) -> int:
         return -(-tokens // self.page_size)
+
+
+class PrefixCache:
+    """Page-aligned prompt-prefix registry for KV reuse across requests.
+
+    Agent loops share long fixed prefixes (system prompt + tool schemas —
+    reference behavior: every task iteration resends the whole conversation,
+    fei/core/task_executor.py:231-252). Full pages of a finished admission
+    register here keyed by the token-prefix hash at each page boundary; a
+    later request reuses its longest cached prefix and prefills only the
+    suffix. Entries hold allocator references (one per page per entry) so
+    shared pages outlive their first sequence; LRU eviction under pool
+    pressure returns them.
+    """
+
+    def __init__(self, alloc: PageAllocator, max_entries: int = 512):
+        self.alloc = alloc
+        self.max_entries = max_entries
+        self._entries: dict[bytes, tuple[tuple[int, ...], int]] = {}
+        self._clock = 0
+
+    @staticmethod
+    def _boundary_keys(prompt_ids, n_pages: int, page_size: int) -> list[bytes]:
+        """Chained per-page digests (the vLLM scheme): key_i = sha256(
+        key_{i-1} || page_i tokens), so all boundary keys for a prompt cost
+        one O(n) pass instead of O(n^2) re-hashing."""
+        import hashlib
+
+        ids = np.asarray(prompt_ids, dtype=np.int32)
+        keys: list[bytes] = []
+        prev = b""
+        for i in range(n_pages):
+            h = hashlib.sha256()
+            h.update(prev)
+            h.update(ids[i * page_size : (i + 1) * page_size].tobytes())
+            prev = h.digest()
+            keys.append(prev)
+        return keys
+
+    def match(self, prompt_ids) -> list[int]:
+        """Longest cached page-aligned prefix STRICTLY shorter than the
+        prompt (at least one suffix token must remain to produce logits).
+        Returns its pages ([] on miss) and touches the entry's LRU clock."""
+        ps = self.alloc.page_size
+        max_m = (len(prompt_ids) - 1) // ps
+        keys = self._boundary_keys(prompt_ids, max_m, ps)
+        for m in range(max_m, 0, -1):
+            hit = self._entries.get(keys[m - 1])
+            if hit is not None:
+                self._clock += 1
+                self._entries[keys[m - 1]] = (hit[0], self._clock)
+                return list(hit[0])
+        return []
+
+    def register(self, prompt_ids, pages: list[int]) -> None:
+        """Register every full-page boundary of a freshly admitted prompt."""
+        ps = self.alloc.page_size
+        full = len(prompt_ids) // ps
+        for m, key in enumerate(self._boundary_keys(prompt_ids, full, ps), 1):
+            if key in self._entries:
+                continue
+            entry_pages = tuple(pages[:m])
+            self.alloc.take_ref(list(entry_pages))
+            self._clock += 1
+            self._entries[key] = (entry_pages, self._clock)
+        while len(self._entries) > self.max_entries:
+            self._evict_one()
+
+    def _evict_one(self) -> bool:
+        if not self._entries:
+            return False
+        key = min(self._entries, key=lambda k: self._entries[k][1])
+        pages, _ = self._entries.pop(key)
+        self.alloc.drop_ref(list(pages))
+        return True
+
+    def evict_for(self, pages_wanted: int) -> None:
+        """Free registry references until ``pages_wanted`` are available (or
+        the registry is empty)."""
+        while self.alloc.free_pages < pages_wanted and self._evict_one():
+            pass
 
 
 def build_block_table(

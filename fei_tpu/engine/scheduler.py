@@ -103,6 +103,8 @@ class PagedScheduler:
 
         self.prefill_chunk = int(_os.environ.get("FEI_TPU_PREFILL_CHUNK", "256"))
         self._admitting: dict | None = None  # in-flight chunked admission
+        self._prefix = None  # PrefixCache when engine.prefix_cache
+        self._gather_jit: dict = {}
 
     # -- public API ---------------------------------------------------------
 
@@ -223,18 +225,33 @@ class PagedScheduler:
                     return
                 seq = self._waiting[0]
                 alloc = self.engine._allocator
+                prefix = (
+                    self._prefix.match(seq.prompt_ids) if self._prefix else []
+                )
+                if prefix:
+                    # pin the matched pages: LRU eviction below must never
+                    # free the entry this admission is about to reuse
+                    alloc.take_ref(prefix)
                 need = alloc.pages_needed(
                     min(len(seq.prompt_ids) + seq.budget, self.engine.max_seq_len)
-                )
+                ) - len(prefix)
+                if need > alloc.free_pages and self._prefix is not None:
+                    # registry references are reclaimable capacity
+                    self._prefix.evict_for(need)
                 if need > alloc.free_pages:
+                    if prefix:
+                        alloc.drop_ref(prefix)
                     return
                 self._waiting.popleft()
                 slot = free[0]
                 self._slots[slot] = seq
                 seq.slot = slot
+                if prefix:
+                    alloc.share(slot, prefix)
+                    alloc.drop_ref(prefix)  # pin handed over to the seq ref
             try:
-                if len(seq.prompt_ids) > self.prefill_chunk:
-                    self._start_chunked(seq, slot)
+                if prefix or len(seq.prompt_ids) > self.prefill_chunk:
+                    self._start_chunked(seq, slot, prefix)
                     return  # one chunked admission at a time
                 self._admit(seq, slot)
             except BaseException as exc:  # noqa: BLE001
@@ -263,28 +280,53 @@ class PagedScheduler:
 
         self._complete_admission(seq, slot, dense, bucket, last_logits)
 
-    def _start_chunked(self, seq: _Seq, slot: int) -> None:
+    def _start_chunked(
+        self, seq: _Seq, slot: int, prefix: list[int] | None = None
+    ) -> None:
         """Begin a chunked admission: pages reserved up front, prompt K/V
         built chunk-by-chunk across loop iterations so concurrent decode
-        streams stall at most one chunk's prefill at a time."""
+        streams stall at most one chunk's prefill at a time. A cached
+        prefix (``prefix`` pages, already shared to the slot) gathers into
+        the dense staging cache and only the suffix prefills."""
         eng = self.engine
         alloc = eng._allocator
+        prefix = prefix or []
+        m = len(prefix)
+        ps = alloc.page_size
         n = len(seq.prompt_ids)
         need = alloc.pages_needed(min(n + seq.budget, eng.max_seq_len))
-        alloc.alloc(slot, need)
+        alloc.alloc(slot, need - m)
         seq.prefilling = True
         from fei_tpu.engine.engine import _next_bucket
 
-        # the bucket MUST be a multiple of the chunk size: every chunk
-        # writes a full C-row slice, and a final chunk extending past the
+        # the bucket MUST fit every full chunk write: chunks write C-row
+        # slices starting at m*ps, and a final chunk extending past the
         # cache would be silently clamped by dynamic_update_slice —
         # corrupting earlier K/V positions instead of erroring
         C = self.prefill_chunk
-        bucket = -(-_next_bucket(n) // C) * C
+        start = m * ps
+        # gather width pads to a power of two so the compile cache stays
+        # log-bounded in prefix length; pad slots read the null page and
+        # anything past m*ps is masked by the cache length (and overwritten
+        # by the suffix chunks where they reach)
+        gm = 1
+        while gm < max(m, 1):
+            gm *= 2
+        bucket = start + -(-max(_next_bucket(n) - start, C) // C) * C
+        # the padded gather writes gm*ps rows at offset 0; the bucket must
+        # hold them or dynamic_update_slice would clamp and corrupt
+        bucket = max(bucket, gm * ps if m else 0)
         dense = KVCache.create(eng.cfg, 1, bucket, dtype=eng.dtype)
+        if m:
+            padded = prefix + [0] * (gm - m)
+            gather = self._gather_fn(gm, bucket)
+            dense = gather(
+                self._pool, jnp.asarray(padded, dtype=jnp.int32), dense,
+                jnp.int32(m * ps),
+            )
         self._admitting = {
             "seq": seq, "slot": slot, "dense": dense,
-            "pos": 0, "bucket": bucket,
+            "pos": start, "bucket": bucket, "prefix": m,
         }
         self._admit_chunk()
 
@@ -316,7 +358,49 @@ class PagedScheduler:
         if hi < n:
             return  # more chunks; decode steps interleave
         self._admitting = None
-        self._complete_admission(seq, st["slot"], st["dense"], st["bucket"], last_logits)
+        self._complete_admission(
+            seq, st["slot"], st["dense"], st["bucket"], last_logits,
+            prefix_pages=st.get("prefix", 0),
+        )
+
+    def _gather_fn(self, gm: int, bucket: int):
+        """Compiled prefix gather: ``gm`` (power-of-two padded) cached pages
+        -> the first gm*ps token positions of a dense staging cache
+        (dequantizing int8 pools), with the cache length set to the TRUE
+        prefix extent (traced). The suffix then prefills against it like
+        any grown cache; pad-page garbage past the true extent is masked by
+        the length and overwritten by the suffix chunks."""
+        key = (gm, bucket)
+        if key not in self._gather_jit:
+            ps = self.engine.page_size
+
+            def gather(pool, pages, dense, true_tokens):
+                # pool pages: [L, P, K, ps, D]; pages: [gm]
+                def pick(pool_pages, scales):
+                    g = pool_pages[:, pages]  # [L, gm, K, ps, D]
+                    if scales is not None:
+                        s = jnp.moveaxis(
+                            scales[:, pages], -1, -2
+                        )  # [L, gm, K, ps, 1]
+                        g = g.astype(jnp.float32) * s
+                    L, _, K, _, D = g.shape
+                    x = jnp.transpose(g, (0, 1, 3, 2, 4)).reshape(
+                        L, gm * ps, K, D
+                    )
+                    return x[:, None].astype(dense.k.dtype)  # [L, 1, gm*ps, K, D]
+
+                k = jax.lax.dynamic_update_slice(
+                    dense.k, pick(pool.k_pages, pool.k_scales), (0, 0, 0, 0, 0)
+                )
+                v = jax.lax.dynamic_update_slice(
+                    dense.v, pick(pool.v_pages, pool.v_scales), (0, 0, 0, 0, 0)
+                )
+                return dense._replace(
+                    k=k, v=v, length=true_tokens[None].astype(jnp.int32),
+                )
+
+            self._gather_jit[key] = jax.jit(gather, donate_argnums=(2,))
+        return self._gather_jit[key]
 
     def _chunk_fn(self, C: int, bucket: int):
         """Compiled one-chunk prefill against a persistent dense cache
@@ -347,12 +431,14 @@ class PagedScheduler:
         return self._chunk_jit[key]
 
     def _complete_admission(
-        self, seq: _Seq, slot: int, dense, bucket: int, last_logits
+        self, seq: _Seq, slot: int, dense, bucket: int, last_logits,
+        prefix_pages: int = 0,
     ) -> None:
         """Shared admission tail: sample the first token on the request's
         own key chain (exactly like the dense single-stream prologue,
-        engine._prefill_sample), scatter prompt K/V into pages, and arm the
-        slot for decode."""
+        engine._prefill_sample), scatter the NEW prompt K/V into pages
+        (cached-prefix pages already hold theirs and are never rewritten),
+        and arm the slot for decode."""
         eng = self.engine
         alloc = eng._allocator
         n = len(seq.prompt_ids)
@@ -369,21 +455,25 @@ class PagedScheduler:
             )[0]
         )
 
-        # prompt K/V → pages + block-table row + length, pool donated
-        pages = alloc.pages_for(slot)
+        # suffix K/V → pages + block-table row + length, pool donated
+        pages = alloc.pages_for(slot)  # prefix pages first, then fresh
         n_prompt_pages = alloc.pages_needed(n)
+        write_pages = pages[prefix_pages:n_prompt_pages]
         width = self._pool.block_table.shape[1]
         row = np.zeros((width,), dtype=np.int32)
         row[: len(pages)] = pages
-        admit_fn = self._admit_fn(bucket, n_prompt_pages)
+        start = prefix_pages * alloc.page_size
+        admit_fn = self._admit_fn(bucket, len(write_pages))
         self._pool = admit_fn(
             self._pool, dense.k, dense.v,
-            jnp.asarray(pages[:n_prompt_pages], dtype=jnp.int32),
+            jnp.asarray(write_pages, dtype=jnp.int32),
             jnp.asarray(row),
-            jnp.int32(slot), jnp.int32(n),
+            jnp.int32(slot), jnp.int32(n), jnp.int32(start),
         )
         self._keys = self._keys.at[slot].set(rng)
         seq.prefilling = False
+        if self._prefix is not None:
+            self._prefix.register(seq.prompt_ids, pages[:n_prompt_pages])
 
         if seq.budget <= 0 or tok0 in seq.stops:
             self._finish(seq)
@@ -492,6 +582,11 @@ class PagedScheduler:
                     self._slots[b] = None
         self._pool = None
         self.engine._pool = None
+        if self._prefix is not None:
+            # the pool's arrays are gone; cached prefixes point at nothing
+            while self._prefix._evict_one():
+                pass
+            self._prefix = None
         for s in doomed:
             s.finished = True
             s.out.put(exc)
@@ -506,6 +601,10 @@ class PagedScheduler:
                 self._pool = self.engine._ensure_pool()
                 self.engine._pool = None  # scheduler owns the arrays now
                 self._keys = jnp.zeros((self.B, 2), dtype=jnp.uint32)
+                if self.engine.prefix_cache and self._prefix is None:
+                    from fei_tpu.engine.paged_cache import PrefixCache
+
+                    self._prefix = PrefixCache(self.engine._allocator)
 
     def _host_mask(self, seq: _Seq, first: bool = False) -> np.ndarray | None:
         if seq.mask_fn is None:
@@ -525,8 +624,11 @@ class PagedScheduler:
             cfg = self.engine.cfg
             ps = self.engine.page_size
 
-            def admit(pool, k_dense, v_dense, page_ids, row, slot, length):
-                # k_dense/v_dense: [L, 1, S, K, D] with S = bucket
+            def admit(pool, k_dense, v_dense, page_ids, row, slot, length, start):
+                # k_dense/v_dense: [L, 1, S, K, D] with S = bucket; only
+                # tokens [start, start + n_pages*ps) scatter (prefix-cached
+                # pages before `start` already hold their K/V). ``start`` is
+                # traced so prefix lengths don't multiply compile variants.
                 L, _, S, K, D = k_dense.shape
                 need = n_pages * ps
 
@@ -538,21 +640,19 @@ class PagedScheduler:
                     v_dense, vs = quant_kv_rows(v_dense)
 
                 def pagesof(x):
-                    if S >= need:
-                        x = x[:, :, :need]
-                    else:
+                    if S < need:
                         x = jnp.pad(
                             x, ((0, 0), (0, 0), (0, need - S), (0, 0), (0, 0))
                         )
+                    x = jax.lax.dynamic_slice_in_dim(x, start, need, axis=2)
                     # [L, 1, n*ps, K, D] -> [n, L, K, ps, D]
                     x = x.reshape(L, n_pages, ps, K, D)
                     return jnp.transpose(x, (1, 0, 3, 2, 4))
 
                 def scalesof(s):
-                    if S >= need:
-                        s = s[:, :, :need]
-                    else:
+                    if S < need:
                         s = jnp.pad(s, ((0, 0), (0, 0), (0, need - S), (0, 0)))
+                    s = jax.lax.dynamic_slice_in_dim(s, start, need, axis=2)
                     # [L, 1, n*ps, K] -> [n, L, K, 1, ps]
                     s = s.reshape(L, n_pages, ps, K)
                     return jnp.transpose(s, (1, 0, 3, 2))[:, :, :, None, :]

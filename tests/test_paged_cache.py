@@ -357,3 +357,83 @@ class TestChunkedPrefill:
         # still serves afterwards
         out = list(eng.scheduler.stream(eng.tokenizer.encode("ok"), gen))
         assert len(out) == 4
+
+
+class TestPrefixCache:
+    """Page-aligned prompt-prefix reuse across requests (opt-in,
+    engine prefix_cache=True): agent loops resend the same system prompt
+    every iteration; cached full pages skip its prefill entirely."""
+
+    def _engine(self, prefix_cache=True, **kw):
+        return InferenceEngine.from_config(
+            "tiny", paged=True, page_size=16, batch_size=2,
+            dtype=jnp.float32, seed=0, tokenizer="byte",
+            max_seq_len=256, num_layers=2, prefix_cache=prefix_cache, **kw,
+        )
+
+    def test_allocator_refcounts(self):
+        from fei_tpu.engine.paged_cache import PageAllocator
+
+        a = PageAllocator(8, 16)
+        got = a.alloc(0, 2)
+        a.share(1, got)
+        a.free(0)
+        assert a.free_pages == 5  # pages still held by seq 1
+        a.free(1)
+        assert a.free_pages == 7
+
+    def test_registry_match_and_evict(self):
+        from fei_tpu.engine.paged_cache import PageAllocator, PrefixCache
+
+        a = PageAllocator(16, 4)
+        reg = PrefixCache(a)
+        prompt = list(range(11))  # 2 full pages + partial
+        pages = a.alloc(0, 3)
+        reg.register(prompt, pages)
+        # longest strict-prefix match: both boundaries cached
+        assert reg.match(prompt) == pages[:2]
+        assert reg.match(prompt[:9]) == pages[:2]
+        assert reg.match(prompt[:5]) == pages[:1]
+        assert reg.match([9, 9, 9, 9, 9]) == []
+        a.free(0)  # seq refs drop; registry refs keep pages alive
+        free_before = a.free_pages
+        reg.evict_for(a.num_pages)  # force-evict everything
+        assert a.free_pages > free_before
+
+    def test_shared_prefix_reused_across_requests(self):
+        gen = GenerationConfig(max_new_tokens=6, temperature=0.0, ignore_eos=True)
+        system = "You are a careful coding assistant. " * 3  # > several pages
+        plain = self._engine(prefix_cache=False)
+        cached = self._engine(prefix_cache=True)
+
+        p1 = cached.tokenizer.encode(system + "Q1: add?", add_bos=True)
+        p2 = cached.tokenizer.encode(system + "Q2: sub?", add_bos=True)
+        want1 = list(plain.scheduler.stream(p1, gen))
+        want2 = list(plain.scheduler.stream(p2, gen))
+
+        got1 = list(cached.scheduler.stream(p1, gen))
+        reg = cached.scheduler._prefix
+        assert reg is not None and len(reg._entries) > 0
+        # second request must hit the cached prefix
+        assert reg.match(p2), "expected a prefix hit for the shared system prompt"
+        got2 = list(cached.scheduler.stream(p2, gen))
+        assert got1 == want1
+        assert got2 == want2
+
+    def test_eviction_under_pool_pressure(self):
+        """A full registry yields its pages back when a new admission
+        needs them."""
+        gen = GenerationConfig(max_new_tokens=4, temperature=0.0, ignore_eos=True)
+        eng = InferenceEngine.from_config(
+            "tiny", paged=True, page_size=16, batch_size=1, num_pages=12,
+            dtype=jnp.float32, seed=0, tokenizer="byte",
+            max_seq_len=128, num_layers=2, prefix_cache=True,
+        )
+        a = eng.tokenizer.encode("a" * 100, add_bos=True)
+        b = eng.tokenizer.encode("b" * 100, add_bos=True)
+        out_a = list(eng.scheduler.stream(a, gen))
+        assert len(out_a) == 4
+        assert len(eng.scheduler._prefix._entries) > 0
+        # b needs most of the small pool: registry pages must be evicted
+        out_b = list(eng.scheduler.stream(b, gen))
+        assert len(out_b) == 4
