@@ -72,6 +72,10 @@ class _Seq:
     cancelled: bool = False
     finished: bool = False
     prefilling: bool = False  # chunked admission in progress (no decode yet)
+    # prefix-cache match memo: None = not yet probed; [] = miss. The hash
+    # chain over the whole prompt is O(n) — computing it once per request
+    # instead of once per admission retry keeps the scheduler lock cheap.
+    prefix_match: list[int] | None = None
 
 
 class PagedScheduler:
@@ -225,13 +229,24 @@ class PagedScheduler:
                     return
                 seq = self._waiting[0]
                 alloc = self.engine._allocator
-                prefix = (
-                    self._prefix.match(seq.prompt_ids) if self._prefix else []
-                )
+                if seq.prefix_match is None:
+                    seq.prefix_match = (
+                        self._prefix.match(seq.prompt_ids) if self._prefix else []
+                    )
+                prefix = seq.prefix_match
                 if prefix:
                     # pin the matched pages: LRU eviction below must never
-                    # free the entry this admission is about to reuse
-                    alloc.take_ref(prefix)
+                    # free the entry this admission is about to reuse. A
+                    # memoized match can go stale if its entry was evicted
+                    # between retries — re-probe once in that case.
+                    try:
+                        alloc.take_ref(prefix)
+                    except EngineError:
+                        seq.prefix_match = prefix = self._prefix.match(
+                            seq.prompt_ids
+                        )
+                        if prefix:
+                            alloc.take_ref(prefix)
                 need = alloc.pages_needed(
                     min(len(seq.prompt_ids) + seq.budget, self.engine.max_seq_len)
                 ) - len(prefix)
