@@ -239,8 +239,19 @@ class JaxLocalProvider(Provider):
         pending: list[int] = []
         text_so_far = ""
         emitted = 0
+        # greedy agent turns use prompt-lookup speculation (token-identical
+        # to plain greedy; multi-token steps whenever output echoes context)
+        speculate = (
+            gen.temperature == 0.0
+            and not self.engine.paged
+            and os.environ.get("FEI_TPU_SPECULATE", "1") != "0"
+        )
+        stream_fn = (
+            self.engine.generate_stream_lookahead
+            if speculate else self.engine.generate_stream
+        )
         with METRICS.span("provider.jax_local"):
-            for tok in self.engine.generate_stream(ids, gen):
+            for tok in stream_fn(ids, gen):
                 out_ids.append(tok)
                 pending.append(tok)
                 ctx_text = self.engine.tokenizer.decode(ctx) if ctx else ""

@@ -627,38 +627,38 @@ class InferenceEngine:
         draft = arr[j + ngram : j + ngram + draft_len].tolist()
         return draft or None
 
-    def generate_lookahead(
+    def generate_stream_lookahead(
         self,
         prompt_ids: Sequence[int],
         gen: GenerationConfig | None = None,
         ngram: int = 3,
         draft_len: int = 8,
-    ) -> GenerationResult:
-        """Greedy decode with prompt-lookup speculation (assisted
+    ) -> Iterator[int]:
+        """Streaming greedy decode with prompt-lookup speculation (assisted
         generation): when the last ``ngram`` tokens repeat earlier context,
         the tokens that followed that occurrence are verified in ONE
         forward of T = 1 + draft_len — agent outputs echo prompt content
         (paths, identifiers, code), so several tokens often land per
-        dispatch. Exactly equal to greedy ``generate`` by construction
-        (accepted tokens are the model's own argmax). Sampled configs and
-        paged engines fall back to the normal path.
+        dispatch. Exactly equal to greedy ``generate_stream`` by
+        construction (accepted tokens are the model's own argmax). Sampled
+        configs and paged engines fall back to the normal stream.
         """
         gen = gen or GenerationConfig()
         if gen.temperature != 0.0 or self.paged:
-            return self.generate(prompt_ids, gen)
+            yield from self.generate_stream(prompt_ids, gen)
+            return
         stops = self._stops(gen)
-        t0 = time.perf_counter()
         budget = min(gen.max_new_tokens, self.max_seq_len - len(prompt_ids))
         tok, cache, _rng = self._prefill_sample(prompt_ids, gen)
-        ttft = time.perf_counter() - t0
-        out: list[int] = []
+        emitted_n = 0
         last = int(tok[0])
         all_ids = list(prompt_ids)
         T = 1 + draft_len
-        while len(out) < budget and last not in stops:
-            out.append(last)
+        while emitted_n < budget and last not in stops:
+            yield last
+            emitted_n += 1
             all_ids.append(last)
-            if len(out) >= budget:
+            if emitted_n >= budget:
                 break
             pos = len(all_ids)  # tokens whose KV the cache must hold next
             draft = self._find_draft(all_ids, ngram, draft_len)
@@ -681,24 +681,44 @@ class InferenceEngine:
             accept = 0
             while accept < draft_len and draft[accept] == int(greedy[accept]):
                 accept += 1
-            emitted = [int(g) for g in greedy[: accept + 1]]
+            block = [int(g) for g in greedy[: accept + 1]]
             # cache holds T new KV rows but only 1 + accept are real; the
             # corrected length masks the rest and later writes overwrite
             cache = cache._replace(
                 length=jnp.full((1,), pos + accept, dtype=jnp.int32)
             )
-            for t in emitted[:-1]:
-                if len(out) >= budget or t in stops:
+            for t in block[:-1]:
+                if emitted_n >= budget or t in stops:
                     last = t
                     break
-                out.append(t)
+                yield t
+                emitted_n += 1
                 all_ids.append(t)
             else:
-                last = emitted[-1]
+                last = block[-1]
                 continue
             break  # hit stop/budget inside the block
+
+    def generate_lookahead(
+        self,
+        prompt_ids: Sequence[int],
+        gen: GenerationConfig | None = None,
+        ngram: int = 3,
+        draft_len: int = 8,
+    ) -> GenerationResult:
+        """Collected form of ``generate_stream_lookahead`` with timings."""
+        gen = gen or GenerationConfig()
+        t0 = time.perf_counter()
+        ttft = None
+        out: list[int] = []
+        for tok in self.generate_stream_lookahead(
+            prompt_ids, gen, ngram=ngram, draft_len=draft_len
+        ):
+            if ttft is None:
+                ttft = time.perf_counter() - t0
+            out.append(tok)
         total = time.perf_counter() - t0
-        return self._make_result(out, len(prompt_ids), ttft, total)
+        return self._make_result(out, len(prompt_ids), ttft or 0.0, total)
 
     def generate_fused(
         self,
