@@ -12,7 +12,6 @@ from __future__ import annotations
 from dataclasses import dataclass
 
 import jax
-import jax.numpy as jnp
 
 from fei_tpu.models.configs import ModelConfig
 from fei_tpu.models.llama import forward_train

@@ -9,7 +9,6 @@ from __future__ import annotations
 import argparse
 import json
 import sys
-import time
 
 from fei_tpu.memory.memdir.archiver import MemoryArchiver
 from fei_tpu.memory.memdir.filters import FilterManager

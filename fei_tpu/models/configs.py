@@ -9,7 +9,7 @@ grouped-query attention; Mixtral swaps the dense MLP for a top-2 router over
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field, replace
+from dataclasses import dataclass, replace
 
 
 @dataclass(frozen=True)

@@ -16,7 +16,7 @@ import inspect
 import re
 import threading
 from concurrent.futures import ThreadPoolExecutor
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any, Callable
 
 from fei_tpu.utils.errors import ToolError, ToolNotFoundError, ToolValidationError

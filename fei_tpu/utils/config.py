@@ -19,7 +19,7 @@ import configparser
 import os
 import stat
 import threading
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any, Callable
 
 from fei_tpu.utils.errors import ConfigError
