@@ -86,6 +86,8 @@ class Assistant:
         self.max_tool_rounds = max_tool_rounds
         self.max_tokens = max_tokens
         self.on_text = on_text  # streaming callback (UI token sink)
+        # per-turn token accounting, summed across tool rounds by chat()
+        self.last_usage: dict = {"prompt_tokens": 0, "completion_tokens": 0}
 
     @property
     def provider(self) -> Provider:
@@ -106,8 +108,11 @@ class Assistant:
             if m["role"] == "tool"
         }
         final_text: list[str] = []
+        self.last_usage = {"prompt_tokens": 0, "completion_tokens": 0}
         for round_no in range(self.max_tool_rounds + 1):
             resp = await self._complete(system, tools)
+            for k, v in (resp.usage or {}).items():
+                self.last_usage[k] = self.last_usage.get(k, 0) + int(v)
             if resp.content:
                 final_text.append(resp.content)
             self.conversation.add_assistant_message(resp.content, resp.tool_calls)
