@@ -181,12 +181,24 @@ class JaxLocalProvider(Provider):
             max_seq = int(cfg.get("jax_local", "max_seq_len", 8192))
             import jax.numpy as jnp
 
+            # serving stack knobs (config file [jax_local] section or
+            # FEI_TPU_JAX_LOCAL_* env): paged pool + continuous batching,
+            # prefix caching for the agent loop's repeated system prompt,
+            # weight-only int8, int8 KV pages. Settings pass through
+            # unfiltered — an inconsistent combination (kv_quant without
+            # paged) surfaces the engine's own loud EngineError instead of
+            # being silently dropped.
             self.engine = InferenceEngine.from_config(
                 model,
                 dtype=jnp.bfloat16,
                 tokenizer=tokenizer,
                 checkpoint_dir=ckpt,
                 max_seq_len=max_seq,
+                paged=cfg.get_bool("jax_local", "paged", False),
+                batch_size=int(cfg.get("jax_local", "batch_size", 1)),
+                quantize=cfg.get("jax_local", "quantize", None) or None,
+                kv_quant=cfg.get("jax_local", "kv_quant", None) or None,
+                prefix_cache=cfg.get_bool("jax_local", "prefix_cache", False),
             )
         self.gen_overrides = gen_overrides or {}
 

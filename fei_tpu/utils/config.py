@@ -93,6 +93,19 @@ CONFIG_SCHEMA: dict[str, dict[str, ConfigValue]] = {
         "mesh_shape": ConfigValue(str, "", description="e.g. 'dp=1,tp=8'; empty = auto"),
         "use_pallas": ConfigValue(bool, True),
     },
+    "jax_local": {
+        "model": ConfigValue(str, "llama3-1b", description="engine model config name"),
+        "checkpoint_dir": ConfigValue(str, None, description="HF safetensors dir"),
+        "tokenizer": ConfigValue(str, None, description="'byte' or local tokenizer path"),
+        "max_seq_len": ConfigValue(int, 8192),
+        "paged": ConfigValue(bool, False, description="paged pool + continuous batching"),
+        "batch_size": ConfigValue(int, 1, description="concurrent decode slots (paged)"),
+        # modes validated by the engine (loud EngineError); no choices here
+        # so a blank INI line means unset rather than a ConfigError
+        "quantize": ConfigValue(str, None, description="weight-only int8 ('int8')"),
+        "kv_quant": ConfigValue(str, None, description="int8 KV pages ('int8', paged)"),
+        "prefix_cache": ConfigValue(bool, False, description="reuse shared prompt-prefix pages (paged)"),
+    },
     "memdir": {
         "base_dir": ConfigValue(str, None),
         "server_url": ConfigValue(str, "http://localhost:5000"),
