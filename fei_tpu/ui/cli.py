@@ -371,6 +371,8 @@ def parse_args(argv: list[str] | None = None) -> argparse.Namespace:
     p.add_argument("--max-tool-rounds", type=int, default=8)
     p.add_argument("--max-tokens", type=int, default=4000)
     p.add_argument("--no-stream", action="store_true", help="print whole replies, not token stream")
+    p.add_argument("--stats", action="store_true",
+                   help="print engine/agent span timings and counters after the turn")
     p.add_argument("--memory-tools", action="store_true", help="register memdir memory tools")
     p.add_argument("--log-level", default=None)
     sub = p.add_subparsers(dest="command")
@@ -412,8 +414,32 @@ def main(argv: list[str] | None = None) -> int:
     except Exception as exc:  # noqa: BLE001 — startup errors must be readable
         print(f"error: {exc}", file=sys.stderr)
         return 2
-    if args.message:
-        return process_single_message(assistant, args.message, history)
-    if args.task:
-        return process_continuous_task(assistant, args.task, args.max_iterations, history)
-    return chat_loop(assistant, history)
+    try:
+        if args.message:
+            return process_single_message(assistant, args.message, history)
+        if args.task:
+            return process_continuous_task(
+                assistant, args.task, args.max_iterations, history
+            )
+        return chat_loop(assistant, history)
+    finally:
+        if getattr(args, "stats", False):
+            print_stats(assistant)
+
+
+def print_stats(assistant=None) -> None:
+    """Span timings + counters + token usage to stderr (observability the
+    reference lacks entirely — SURVEY §5 'Tracing/profiling: none')."""
+    from fei_tpu.utils.metrics import METRICS
+
+    snap = METRICS.snapshot()
+    print("\n-- stats ----------------------------------------", file=sys.stderr)
+    if assistant is not None and getattr(assistant, "last_usage", None):
+        u = assistant.last_usage
+        print(f"tokens: prompt={u.get('prompt_tokens', 0)} "
+              f"completion={u.get('completion_tokens', 0)}", file=sys.stderr)
+    for name, s in sorted(snap.get("spans", {}).items()):
+        print(f"{name:24s} n={s['count']:<5d} mean={s['mean_s']*1000:8.1f}ms "
+              f"total={s['total_s']:7.2f}s", file=sys.stderr)
+    for name, v in sorted(snap.get("counters", {}).items()):
+        print(f"{name:24s} {v}", file=sys.stderr)

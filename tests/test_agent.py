@@ -386,3 +386,16 @@ class TestHistoryLoad:
         monkeypatch.setattr(cli, "HISTORY_FILE", str(tmp_home / "h.json"))
         args = cli.parse_args(["history", "load", "7"])
         assert cli.handle_history_command(args) == 1
+
+
+class TestCLIStats:
+    def test_stats_flag_prints_summary(self, capsys, tmp_path, monkeypatch):
+        import fei_tpu.ui.cli as cli
+
+        monkeypatch.setattr(cli, "HISTORY_FILE", str(tmp_path / "h.json"))
+        rc = cli.main(
+            ["--provider", "mock", "--no-stream", "--stats", "--message", "hi"]
+        )
+        err = capsys.readouterr().err
+        assert rc == 0
+        assert "-- stats" in err and "tokens:" in err
