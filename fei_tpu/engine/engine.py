@@ -773,4 +773,8 @@ class InferenceEngine:
 
     def chat(self, messages: list[dict], gen: GenerationConfig | None = None) -> GenerationResult:
         ids = self.tokenizer.apply_chat_template(messages, add_generation_prompt=True)
+        gen = gen or GenerationConfig()
+        if gen.temperature == 0.0 and not self.paged:
+            # chat turns echo conversation content; prompt lookup is free
+            return self.generate_lookahead(ids, gen)
         return self.generate(ids, gen)
