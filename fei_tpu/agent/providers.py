@@ -181,6 +181,14 @@ class JaxLocalProvider(Provider):
             max_seq = int(cfg.get("jax_local", "max_seq_len", 8192))
             import jax.numpy as jnp
 
+            if not ckpt:
+                log.warning(
+                    "jax_local provider has no checkpoint_dir configured — "
+                    "decoding with RANDOM %s weights (output will be noise)."
+                    " Set [jax_local] checkpoint_dir (or "
+                    "FEI_TPU_JAX_LOCAL_CHECKPOINT_DIR) to a local HF "
+                    "safetensors directory.", model,
+                )
             # serving stack knobs (config file [jax_local] section or
             # FEI_TPU_JAX_LOCAL_* env): paged pool + continuous batching,
             # prefix caching for the agent loop's repeated system prompt,
