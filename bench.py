@@ -72,6 +72,9 @@ def _prompt(engine):
 
 
 def _emit(metric: str, value: float, unit: str = "tok/s/chip") -> int:
+    if os.environ.get("FEI_TPU_BENCH_CPU_FALLBACK"):
+        # never let a CPU liveness number masquerade as a TPU measurement
+        metric = f"{metric}_CPU_FALLBACK_TPU_UNAVAILABLE"
     print(json.dumps({
         "metric": metric,
         "value": round(value, 2),
@@ -96,8 +99,16 @@ def _touch_backend_or_reexec():
         devices = jax.devices()
     except Exception as exc:  # noqa: BLE001
         if attempt >= 4:
-            log(f"bench: backend unavailable after {attempt + 1} attempts: {exc!r}")
-            raise
+            # last resort: emit an EXPLICITLY-LABELED CPU-fallback line on a
+            # tiny model rather than dying with no JSON at all — the metric
+            # name says it is NOT a TPU measurement (r2: the axon backend
+            # was down for hours; rc=1 benches record nothing)
+            log(f"bench: backend unavailable after {attempt + 1} attempts "
+                f"({exc!r}); falling back to an explicitly-labeled CPU run")
+            jax.config.update("jax_platforms", "cpu")
+            os.environ["FEI_TPU_BENCH_MODEL"] = "tiny"
+            os.environ["FEI_TPU_BENCH_CPU_FALLBACK"] = "1"
+            return "cpu (TPU-UNAVAILABLE FALLBACK)", jax.devices()
         delay = 30 * (2 ** attempt)
         log(f"bench: backend init failed ({exc!r}); retry {attempt + 1}/4 "
             f"in {delay}s")
@@ -329,6 +340,9 @@ def main() -> int:
 
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     backend, devices = _touch_backend_or_reexec()
+    if os.environ.get("FEI_TPU_BENCH_CPU_FALLBACK"):
+        model = os.environ["FEI_TPU_BENCH_MODEL"]  # shrunk to 'tiny'
+        n_tokens = min(n_tokens, 32)
     log(f"bench: suite={suite} model={model} backend={backend} devices={devices}")
 
     if suite == "paged":
