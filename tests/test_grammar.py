@@ -480,3 +480,34 @@ class TestOnDeviceConstrained:
         assert got == want
         assert paged._allocator.free_pages == paged._allocator.num_pages - 1
         json.loads(paged.tokenizer.decode(got))
+
+
+class TestConstrainedServingInteractions:
+    def test_constrained_chunked_prefill_prefix_cache(self, monkeypatch):
+        """Grammar-constrained decode through the scheduler with a
+        chunk-prefilled long prompt AND prefix caching: the mask pipeline,
+        chunked admission, and page reuse must compose — constrained output
+        still parses, and a second request reuses the cached prefix."""
+        monkeypatch.setenv("FEI_TPU_PREFILL_CHUNK", "16")
+        schema = {"type": "object", "properties": {"q": {"type": "string"}}}
+        eng = InferenceEngine.from_config(
+            "tiny", paged=True, page_size=16, batch_size=2,
+            dtype=jnp.float32, seed=0, tokenizer="byte",
+            max_seq_len=256, num_layers=2, prefix_cache=True,
+        )
+        tg = compile_tool_call_grammar(schema, eng.tokenizer)
+        gen = GenerationConfig(max_new_tokens=48, temperature=0.0)
+        system = "shared system prompt " * 4  # > several pages, chunked
+        for i in range(2):
+            prompt = eng.tokenizer.encode(system + f"request {i}", add_bos=True)
+            toks = list(
+                eng.scheduler.stream(
+                    prompt, gen, logit_mask_fn=tg.logit_mask_fn(gen.max_new_tokens)
+                )
+            )
+            text = eng.tokenizer.decode(
+                [t for t in toks if t not in eng.tokenizer.stop_token_ids]
+            )
+            obj = json.loads(text)  # constrained output must parse
+            assert set(obj).issubset({"q"})
+        assert len(eng.scheduler._prefix._entries) > 0
