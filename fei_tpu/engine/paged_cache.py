@@ -151,18 +151,25 @@ class PageAllocator:
 
     def share(self, seq_id: int, pages: list[int]) -> None:
         """Add existing (cached-prefix) pages to a sequence: refcount++
-        each; they precede any later alloc()'d pages in pages_for order."""
+        each; they precede any later alloc()'d pages in pages_for order.
+        All-or-nothing: a dead page anywhere in the list leaves every
+        refcount untouched."""
         for p in pages:
             if self._refs.get(p, 0) <= 0:
                 raise EngineError(f"cannot share unreferenced page {p}")
+        for p in pages:
             self._refs[p] += 1
         self._owned.setdefault(seq_id, []).extend(pages)
 
     def take_ref(self, pages: list[int]) -> None:
-        """Registry-held references (prefix cache entries)."""
+        """Registry-held references (prefix cache entries). All-or-nothing:
+        validate every page before incrementing any, so a stale entry whose
+        tail page was recycled cannot leak references on its live head pages
+        (the scheduler catches the error and re-probes the registry)."""
         for p in pages:
             if self._refs.get(p, 0) <= 0:
                 raise EngineError(f"cannot reference dead page {p}")
+        for p in pages:
             self._refs[p] += 1
 
     def drop_ref(self, pages: list[int]) -> None:

@@ -236,9 +236,10 @@ class PagedScheduler:
                 prefix = seq.prefix_match
                 if prefix:
                     # pin the matched pages: LRU eviction below must never
-                    # free the entry this admission is about to reuse. A
-                    # memoized match can go stale if its entry was evicted
-                    # between retries — re-probe once in that case.
+                    # free the entry this admission is about to reuse.
+                    # Defensive: memoized matches are re-probed whenever the
+                    # pin is dropped (below), so a stale match should be
+                    # impossible — but recover by re-probing if one appears.
                     try:
                         alloc.take_ref(prefix)
                     except EngineError:
@@ -256,6 +257,12 @@ class PagedScheduler:
                 if need > alloc.free_pages:
                     if prefix:
                         alloc.drop_ref(prefix)
+                        # the pin is gone: a page of the memoized match can
+                        # be recycled before the retry, and take_ref's
+                        # refcount>0 probe cannot tell "same content" from
+                        # "page reused by another sequence" — force the
+                        # retry to re-probe the registry instead
+                        seq.prefix_match = None
                     return
                 self._waiting.popleft()
                 slot = free[0]
@@ -327,7 +334,18 @@ class PagedScheduler:
         gm = 1
         while gm < max(m, 1):
             gm *= 2
-        bucket = start + -(-max(_next_bucket(n) - start, C) // C) * C
+        # cap the power-of-two pad target at max_seq_len BEFORE the
+        # ceil-to-chunk: a near-max_seq_len prompt must not stage a cache
+        # ~2x larger than the engine will ever read. The ceil-to-chunk then
+        # keeps bucket >= start + ceil((n-start)/C)*C — every chunk write
+        # fits, so dynamic_update_slice never clamps (n <= max_seq_len)
+        target = min(_next_bucket(n), eng.max_seq_len)
+        bucket = start + -(-max(target - start, C) // C) * C
+        # …and round to a page multiple: the dense→paged scatter at
+        # completion slices [start, ceil(n/ps)*ps) and its slice start
+        # would clamp (misaligning every suffix page) if the capped,
+        # C-granular bucket fell below that page-aligned extent
+        bucket = -(-bucket // ps) * ps
         # the padded gather writes gm*ps rows at offset 0; the bucket must
         # hold them or dynamic_update_slice would clamp and corrupt
         bucket = max(bucket, gm * ps if m else 0)

@@ -465,14 +465,31 @@ def save_checkpoint(params: dict, path: str) -> None:
         raise CheckpointError(f"orbax save to {path} failed: {e}", cause=e)
 
 
+def _is_qtensor_shaped(q, s) -> bool:
+    """True iff s's shape is q's with exactly one axis collapsed to 1 —
+    the keepdims contraction-scale layout QTensor guarantees (ops/quant.py).
+    Guards _retype_qtensors against coercing a user checkpoint that merely
+    happens to store an int8 leaf named 'q' beside 's'."""
+    qs = getattr(q, "shape", None)
+    ss = getattr(s, "shape", None)
+    if qs is None or ss is None or len(qs) != len(ss):
+        return False
+    mismatch = [i for i, (a, b) in enumerate(zip(qs, ss)) if a != b]
+    # zero mismatches = degenerate contraction axis of size 1 (s.shape ==
+    # q.shape) — still a layout quantize() itself produces, keep round-trip
+    return len(mismatch) == 0 or (len(mismatch) == 1 and ss[mismatch[0]] == 1)
+
+
 def _retype_qtensors(tree):
     """Orbax round-trips NamedTuples as plain dicts; rebuild QTensor leaves
-    (recognized by their exact {q: int8, s} field pair) so quantized
-    checkpoints restore into working pytrees."""
+    (recognized by their exact {q: int8, s} field pair plus the keepdims
+    scale-shape relationship) so quantized checkpoints restore into working
+    pytrees."""
     if isinstance(tree, dict):
         if (
             set(tree.keys()) == {"q", "s"}
             and getattr(tree["q"], "dtype", None) == jnp.int8
+            and _is_qtensor_shaped(tree["q"], tree["s"])
         ):
             return QTensor(q=tree["q"], s=tree["s"])
         return {k: _retype_qtensors(v) for k, v in tree.items()}
