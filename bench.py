@@ -17,12 +17,16 @@ Suites (FEI_TPU_BENCH_SUITE):
                      config (BASELINE config #4 on one chip)
 
 Knobs:
-  FEI_TPU_BENCH_MODEL    (default llama3-1b; paged uses it too; moe uses moe-2b)
+  FEI_TPU_BENCH_MODEL    (decode default llama3-8b — the BASELINE config #2
+                          gate scale; paged/agent default llama3-1b; moe
+                          uses moe-2b)
   FEI_TPU_BENCH_TOKENS   (default 256)
   FEI_TPU_BENCH_PROMPT   (default ~128 tokens)
-  FEI_TPU_BENCH_QUANT    ("int8" -> weight-only int8; an 8B then fits the
-                          16 GB chip: FEI_TPU_BENCH_MODEL=llama3-8b)
+  FEI_TPU_BENCH_QUANT    ("int8" -> weight-only int8. Defaults to int8 for
+                          the llama3-8b decode suite so 8B + KV fits the
+                          16 GB chip; set empty to opt out)
   FEI_TPU_BENCH_STREAMS  (paged suite concurrency, default 4)
+  FEI_TPU_BENCH_MAX_WAIT_S (total backend-retry wall-clock budget, 900)
 """
 
 from __future__ import annotations
@@ -84,40 +88,130 @@ def _emit(metric: str, value: float, unit: str = "tok/s/chip") -> int:
     return 0
 
 
-def _touch_backend_or_reexec():
-    """First device touch, with retry via re-exec.
+def _probe_backend(timeout_s: float):
+    """Touch the backend in a SUBPROCESS so a hung attach cannot consume the
+    caller's whole timeout (round-2 BENCH died at rc=124: the backend was
+    down and the in-process retry loop ate the driver's budget). Returns
+    ("ok", backend_name) / ("error", msg) / ("timeout", msg).
 
-    A transiently unavailable axon/TPU backend raises at init and the failure
-    is cached for the process lifetime, so an in-process retry is useless —
-    re-exec ourselves with backoff instead (round-1 BENCH died here, rc=1).
+    A probe that outlives ``timeout_s`` is ABANDONED, never killed: killing
+    a client mid-claim wedges the chip lease (observed during the round-2
+    outage — every subsequent attach then hangs for many minutes). The
+    orphaned child writes to a scratch file, finishes its attach on its own
+    schedule, and exits cleanly, releasing any claim it acquired."""
+    import subprocess
+    import tempfile
+
+    outfile = tempfile.NamedTemporaryFile(
+        mode="w+", suffix=".probe", delete=False
+    )
+    code = (
+        "import jax, json, sys; ds = jax.devices(); "
+        "print('PROBE ' + json.dumps([jax.default_backend(), len(ds)])); "
+        "sys.stdout.flush()"
+    )
+    proc = subprocess.Popen(
+        [sys.executable, "-c", code],
+        stdout=outfile, stderr=subprocess.STDOUT, text=True,
+        start_new_session=True,  # survives our exit if abandoned
+    )
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        if proc.poll() is not None:
+            break
+        time.sleep(1.0)
+    if proc.poll() is None:
+        # leave it attaching; it will release its claim when it finishes.
+        # unlink-by-path is safe while the orphan still holds its fd
+        outfile.close()
+        os.unlink(outfile.name)
+        return "timeout", (
+            f"attach exceeded {timeout_s:.0f}s (probe pid {proc.pid} "
+            "left to finish on its own — killing mid-claim wedges the lease)"
+        )
+    outfile.seek(0)
+    text = outfile.read()
+    outfile.close()
+    os.unlink(outfile.name)
+    if proc.returncode == 0:
+        for line in text.splitlines():
+            if line.startswith("PROBE "):
+                backend, n = json.loads(line[6:])
+                return "ok", f"{backend} ({n} devices)"
+        return "error", "probe printed no marker"
+    tail = text.strip().splitlines()[-3:]
+    return "error", " | ".join(tail)[-300:]
+
+
+def _touch_backend_or_reexec():
+    """First device touch, bounded by a TOTAL wall-clock budget.
+
+    The backend is probed in a subprocess (hang-safe); only after a
+    successful probe does this process attach. A transiently unavailable
+    axon/TPU backend raises at init and the failure is cached for the
+    process lifetime, so if the in-process attach still fails we re-exec
+    with backoff. Once FEI_TPU_BENCH_MAX_WAIT_S (default 900 s) of total
+    waiting is spent, emit an EXPLICITLY-LABELED CPU-fallback line on a tiny
+    model rather than dying with no JSON at all — the metric name says it is
+    NOT a TPU measurement.
     """
     import jax
 
+    budget = float(os.environ.get("FEI_TPU_BENCH_MAX_WAIT_S", "900"))
+    t0 = float(os.environ.setdefault("FEI_TPU_BENCH_T0", repr(time.time())))
+
+    def fallback(reason: str):
+        log(f"bench: TPU unavailable ({reason}); "
+            "falling back to an explicitly-labeled CPU run")
+        jax.config.update("jax_platforms", "cpu")
+        os.environ["FEI_TPU_BENCH_MODEL"] = "tiny"
+        os.environ["FEI_TPU_BENCH_CPU_FALLBACK"] = "1"
+        return "cpu (TPU-UNAVAILABLE FALLBACK)", jax.devices()
+
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        # explicit CPU smoke run — no probe dance, no fallback relabeling
+        return jax.default_backend(), jax.devices()
+
     attempt = int(os.environ.get("FEI_TPU_BENCH_ATTEMPT", "0"))
-    try:
-        backend = jax.default_backend()
-        devices = jax.devices()
-    except Exception as exc:  # noqa: BLE001
-        if attempt >= 4:
-            # last resort: emit an EXPLICITLY-LABELED CPU-fallback line on a
-            # tiny model rather than dying with no JSON at all — the metric
-            # name says it is NOT a TPU measurement (r2: the axon backend
-            # was down for hours; rc=1 benches record nothing)
-            log(f"bench: backend unavailable after {attempt + 1} attempts "
-                f"({exc!r}); falling back to an explicitly-labeled CPU run")
-            jax.config.update("jax_platforms", "cpu")
-            os.environ["FEI_TPU_BENCH_MODEL"] = "tiny"
-            os.environ["FEI_TPU_BENCH_CPU_FALLBACK"] = "1"
-            return "cpu (TPU-UNAVAILABLE FALLBACK)", jax.devices()
-        delay = 30 * (2 ** attempt)
-        log(f"bench: backend init failed ({exc!r}); retry {attempt + 1}/4 "
-            f"in {delay}s")
+    while True:
+        remaining = budget - (time.time() - t0)
+        if remaining <= 0:
+            return fallback(f"retry budget ({budget:.0f}s) exhausted")
+        status, detail = _probe_backend(min(max(remaining, 30.0), 600.0))
+        if status == "ok":
+            log(f"bench: backend probe ok: {detail}")
+            break
+        if status == "timeout":
+            # the backend is hung (the probe is still blocked in attach and
+            # was ABANDONED, not killed) — attaching in-process would hang
+            # the same way; give up cleanly while the budget allows
+            return fallback(f"backend attach hung: {detail}")
+        attempt += 1
+        os.environ["FEI_TPU_BENCH_ATTEMPT"] = str(attempt)
+        remaining = budget - (time.time() - t0)
+        if remaining <= 0:
+            return fallback(f"retry budget ({budget:.0f}s) exhausted")
+        delay = min(30.0 * (2 ** (attempt - 1)), 120.0, remaining)
+        log(f"bench: backend probe failed ({detail}); retry {attempt} "
+            f"in {delay:.0f}s ({remaining:.0f}s of budget left)")
         time.sleep(delay)
-        os.environ["FEI_TPU_BENCH_ATTEMPT"] = str(attempt + 1)
+    try:
+        return jax.default_backend(), jax.devices()
+    except Exception as exc:  # noqa: BLE001
+        # probe succeeded but our (cached-for-life) init failed — re-exec to
+        # clear the cache, with backoff and a cap so a flapping backend
+        # isn't hammered with attach cycles for the whole budget
+        execs = int(os.environ.get("FEI_TPU_BENCH_EXEC_ATTEMPT", "0"))
+        delay = 30.0 * (2 ** execs)
+        if execs >= 3 or time.time() - t0 + delay >= budget:
+            return fallback(f"in-process attach failed: {exc!r}")
+        os.environ["FEI_TPU_BENCH_EXEC_ATTEMPT"] = str(execs + 1)
+        log(f"bench: in-process attach failed after ok probe ({exc!r}); "
+            f"re-exec {execs + 1}/3 in {delay:.0f}s")
+        time.sleep(delay)
         sys.stdout.flush()
         sys.stderr.flush()
         os.execv(sys.executable, [sys.executable] + sys.argv)
-    return backend, devices
 
 
 def bench_decode(model: str, n_tokens: int) -> int:
@@ -329,9 +423,22 @@ def bench_agent(model: str, n_tokens: int) -> int:
 
 def main() -> int:
     suite = os.environ.get("FEI_TPU_BENCH_SUITE", "decode")
-    model = os.environ.get(
-        "FEI_TPU_BENCH_MODEL", "moe-2b" if suite == "moe" else "llama3-1b"
-    )
+    if suite == "moe":
+        default_model = "moe-2b"
+    elif suite == "decode":
+        # BASELINE config #2 gate scale: Llama-3-8B on ONE chip. int8
+        # weight-only (~8 GB) is what makes 8B + KV fit the 16 GB v5e;
+        # export FEI_TPU_BENCH_QUANT= (empty) to opt out explicitly.
+        default_model = "llama3-8b"
+    else:
+        default_model = "llama3-1b"
+    model = os.environ.get("FEI_TPU_BENCH_MODEL", default_model)
+    if (
+        suite == "decode"
+        and model == "llama3-8b"
+        and "FEI_TPU_BENCH_QUANT" not in os.environ
+    ):
+        os.environ["FEI_TPU_BENCH_QUANT"] = "int8"
     n_tokens = int(os.environ.get("FEI_TPU_BENCH_TOKENS", "256"))
     if os.environ.get("JAX_PLATFORMS"):
         # the container's sitecustomize pins the axon TPU platform and
