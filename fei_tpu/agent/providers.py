@@ -6,9 +6,11 @@ tool_calls). Three implementations:
 
 - ``JaxLocalProvider`` — the north-star path: an fei_tpu.engine
   InferenceEngine decoding on the local TPU; zero external API calls.
-  Tool calls are emitted as ``<tool_call>{json}</tool_call>`` blocks and
-  parsed here (optionally enforced on-device by grammar-constrained
-  decoding, fei_tpu.engine.grammar).
+  Tool calls are emitted as ``<tool_call>{json}</tool_call>`` blocks and,
+  by default, ENFORCED during generation by the registry-union tool-call
+  grammar (fei_tpu.engine.grammar; engine.generate_stream_toolcalls runs
+  the DFA on device) — an emitted call cannot be unparseable. Set
+  ``[jax_local] constrain_tools = false`` for post-hoc parsing only.
 - ``MockProvider`` — scripted responses for hermetic agent-loop tests
   (the same role the reference's patched litellm_completion plays,
   fei/tests/test_litellm.py:51-110).
@@ -90,19 +92,19 @@ _OPEN_TAG = "<tool_call>"
 _CLOSE_TAG = "</tool_call>"
 
 
-def stream_visible(text: str) -> str:
+def stream_visible(text: str, open_tag: str = _OPEN_TAG) -> str:
     """The portion of a partially-decoded response that is safe to show:
     completed tool-call blocks are removed, an unfinished block or a trailing
-    partial ``<tool_call>`` tag is held back. Monotonic in ``text`` growth,
+    partial ``open_tag`` is held back. Monotonic in ``text`` growth,
     so a streaming UI can emit deltas of it."""
     out: list[str] = []
     pos = 0
     while True:
-        i = text.find(_OPEN_TAG, pos)
+        i = text.find(open_tag, pos)
         if i < 0:
             rest = text[pos:]
-            for k in range(min(len(_OPEN_TAG) - 1, len(rest)), 0, -1):
-                if rest.endswith(_OPEN_TAG[:k]):
+            for k in range(min(len(open_tag) - 1, len(rest)), 0, -1):
+                if rest.endswith(open_tag[:k]):
                     rest = rest[:-k]
                     break
             out.append(rest)
@@ -115,9 +117,22 @@ def stream_visible(text: str) -> str:
     return "".join(out)
 
 
-def extract_tool_calls(text: str) -> tuple[str, list[ToolCall]]:
-    """Parse ``<tool_call>{...}</tool_call>`` blocks out of model text."""
+def extract_tool_calls(
+    text: str, open_tag: str = _OPEN_TAG
+) -> tuple[str, list[ToolCall]]:
+    """Parse ``<tool_call>{...}</tool_call>`` blocks out of model text.
+    ``open_tag`` tracks the provider's (configurable) trigger tag; the
+    close tag is always ``</tool_call>`` — the engine emits it after the
+    grammar accepts."""
     calls: list[ToolCall] = []
+    rx = (
+        _TOOL_CALL_RX
+        if open_tag == _OPEN_TAG
+        else re.compile(
+            re.escape(open_tag) + r"\s*(\{.*?\})\s*" + re.escape(_CLOSE_TAG),
+            re.DOTALL,
+        )
+    )
 
     def _strip(m: re.Match) -> str:
         try:
@@ -137,15 +152,16 @@ def extract_tool_calls(text: str) -> tuple[str, list[ToolCall]]:
         )
         return ""
 
-    cleaned = _TOOL_CALL_RX.sub(_strip, text).strip()
+    cleaned = rx.sub(_strip, text).strip()
     return cleaned, calls
 
 
-def render_tool_prompt(tools: list[dict]) -> str:
+def render_tool_prompt(tools: list[dict], open_tag: str = _OPEN_TAG) -> str:
     """System-prompt section teaching the tool-call emission protocol."""
     lines = [
         "You can call tools. To call one, emit exactly:",
-        '<tool_call>{"name": "<tool name>", "arguments": {...}}</tool_call>',
+        f'{open_tag}{{"name": "<tool name>", "arguments": {{...}}}}'
+        f"{_CLOSE_TAG}",
         "Tool results arrive in the next turn. Available tools:",
     ]
     for t in tools:
@@ -209,13 +225,62 @@ class JaxLocalProvider(Provider):
                 prefix_cache=cfg.get_bool("jax_local", "prefix_cache", False),
             )
         self.gen_overrides = gen_overrides or {}
+        cfg = get_config()
+        # on-device grammar enforcement of tool calls (engine.grammar):
+        # an emitted <tool_call> block CANNOT be unparseable. On by
+        # default; [jax_local] constrain_tools = false restores post-hoc
+        # parsing (the reference's trust-then-validate contract,
+        # fei/tools/registry.py:92-153). The trigger is configurable so
+        # hermetic tests can drive the constrained path with random weights.
+        self.constrain_tools = cfg.get_bool("jax_local", "constrain_tools", True)
+        self.tool_trigger = cfg.get("jax_local", "tool_trigger", _OPEN_TAG)
+        self._grammar_cache: dict = {}
+
+    def _tool_grammar(self, tools: list[dict] | None):
+        """Registry-union TokenGrammar for ``tools``, memoized per schema
+        set (the token-table lift costs seconds at 128k vocab)."""
+        if not tools or not self.constrain_tools:
+            return None
+        try:
+            key = json.dumps(
+                [
+                    {t["name"]: t.get("input_schema", t.get("parameters"))}
+                    for t in tools
+                ],
+                sort_keys=True, default=str,
+            )
+        except (KeyError, TypeError) as exc:
+            log.warning("unhashable tool list (%s); tool grammar disabled", exc)
+            return None
+        if key not in self._grammar_cache:
+            from fei_tpu.engine.grammar import compile_agent_tool_grammar
+            from fei_tpu.utils.errors import EngineError
+
+            try:
+                g = compile_agent_tool_grammar(tools, self.engine.tokenizer)
+                log.info(
+                    "tool-call grammar compiled: %d tools, %d states, "
+                    "%.1f MB tables, lift %.2fs",
+                    len(tools), g.table.shape[0], g.table_bytes / 1e6,
+                    g.lift_seconds,
+                )
+            except EngineError as exc:
+                log.warning(
+                    "tool grammar compile failed (%s); falling back to "
+                    "post-hoc tool-call parsing", exc,
+                )
+                g = None
+            self._grammar_cache[key] = g
+        return self._grammar_cache[key]
 
     def _messages_with_system(
         self, messages: list[dict], system: str | None, tools: list[dict] | None
     ) -> list[dict]:
         sys_parts = [system] if system else []
         if tools:
-            sys_parts.append(render_tool_prompt(tools))
+            sys_parts.append(
+                render_tool_prompt(tools, getattr(self, "tool_trigger", _OPEN_TAG))
+            )
         out = []
         if sys_parts:
             out.append({"role": "system", "content": "\n\n".join(sys_parts)})
@@ -259,17 +324,26 @@ class JaxLocalProvider(Provider):
         pending: list[int] = []
         text_so_far = ""
         emitted = 0
+        grammar = self._tool_grammar(tools)
         # greedy agent turns use prompt-lookup speculation (token-identical
         # to plain greedy; multi-token steps whenever output echoes context)
         speculate = (
             gen.temperature == 0.0
             and not self.engine.paged
+            and grammar is None
             and os.environ.get("FEI_TPU_SPECULATE", "1") != "0"
         )
-        stream_fn = (
-            self.engine.generate_stream_lookahead
-            if speculate else self.engine.generate_stream
-        )
+        if grammar is not None:
+            import functools
+
+            stream_fn = functools.partial(
+                self.engine.generate_stream_toolcalls,
+                grammar=grammar, trigger=self.tool_trigger,
+            )
+        elif speculate:
+            stream_fn = self.engine.generate_stream_lookahead
+        else:
+            stream_fn = self.engine.generate_stream
         with METRICS.span("provider.jax_local"):
             for tok in stream_fn(ids, gen):
                 out_ids.append(tok)
@@ -279,11 +353,11 @@ class JaxLocalProvider(Provider):
                 text_so_far = stable + tail
                 if len(pending) >= 128 and tail and not tail.endswith("�"):
                     stable, ctx, pending = text_so_far, pending[-8:], []
-                visible = stream_visible(text_so_far)
+                visible = stream_visible(text_so_far, self.tool_trigger)
                 if len(visible) > emitted:
                     yield visible[emitted:]
                     emitted = len(visible)
-        content, calls = extract_tool_calls(text_so_far)
+        content, calls = extract_tool_calls(text_so_far, self.tool_trigger)
         return ProviderResponse(
             content=content,
             tool_calls=calls,

@@ -589,6 +589,128 @@ class InferenceEngine:
                 )
                 tok_host = int(tok[0])  # host sync inside the span
 
+    def generate_stream_toolcalls(
+        self,
+        prompt_ids: Sequence[int],
+        gen: GenerationConfig | None = None,
+        grammar=None,
+        trigger: str = "<tool_call>",
+        close: str = "</tool_call>",
+        chunk: int = 16,
+    ) -> Iterator[int]:
+        """Stream an agent turn with ON-DEVICE tool-call grammar enforcement.
+
+        Free decoding runs until the generated text emits ``trigger``; the
+        stream then switches into the fused grammar scan
+        (``_grammar_fused_fn`` — DFA state and mask live inside the scanned
+        device program, zero per-token host round-trips) against the SAME
+        kv cache, until the DFA accepts a complete
+        ``{"name":...,"arguments":{...}}`` object. The close-tag token ids
+        are then yielded (not fed back — the turn ends at ``tool_use``, and
+        the conversation is re-prefilled next turn) and the stream ends.
+
+        This is the generation-side replacement for the reference's
+        trust-then-validate tool protocol (fei/tools/registry.py:92-153):
+        an emitted tool call *cannot* be unparseable. ``grammar`` is the
+        registry-union TokenGrammar (grammar.compile_agent_tool_grammar).
+        Paged engines route through the scheduler with the equivalent
+        host-side mask (grammar.toolcall_stream_mask_fn), so constrained
+        turns batch with other in-flight streams.
+        """
+        gen = gen or GenerationConfig()
+        if grammar is None:
+            yield from self.generate_stream(prompt_ids, gen)
+            return
+        from fei_tpu.engine.grammar import (
+            TriggerScanner,
+            char_walk,
+            toolcall_stream_mask_fn,
+        )
+
+        close_ids = self.tokenizer.encode(close)
+        budget = min(gen.max_new_tokens, self.max_seq_len - len(prompt_ids))
+        if self.paged:
+            fn, mstate = toolcall_stream_mask_fn(
+                grammar, self.tokenizer, trigger, max_tokens=budget,
+            )
+            yield from self.scheduler.stream(prompt_ids, gen, fn)
+            if mstate["accepted"]:
+                yield from close_ids
+            return
+
+        stops = self._stops(gen)
+        scanner = TriggerScanner(self.tokenizer, trigger)
+        tok, cache, rng = self._prefill_sample(prompt_ids, gen)
+        step = self._step_fn(gen)
+        tok_host = int(tok[0])
+        gstate = -1
+        i = 0
+        # ---- free phase: incremental trigger detection on streamed text --
+        while i < budget:
+            if tok_host in stops:
+                return
+            yield tok_host
+            i += 1
+            suffix = scanner.feed(tok_host)
+            if suffix is not None:
+                gstate = char_walk(grammar, suffix)
+                if gstate >= 0:
+                    break  # enter the constrained phase
+                METRICS.incr("engine.grammar_trigger_suffix_rejected")
+            if i >= budget:
+                return
+            with METRICS.span("decode_step"):
+                tok, cache, rng = step(
+                    self.params, cache, tok.reshape(1, 1), rng, None
+                )
+                tok_host = int(tok[0])
+        if gstate < 0 or i >= budget:
+            return
+        if gstate == grammar.accept:
+            # degenerate: the trigger token carried the whole call
+            yield from close_ids
+            return
+        # ---- constrained phase: fused DFA scan on the live cache ----
+        if int(grammar.min_dist[gstate]) > budget - i:
+            METRICS.incr("engine.grammar_budget_too_small")
+            return  # cannot complete a valid call; truncate like any budget
+        table, min_dist = grammar.device_tables(self.cfg.vocab_size)
+        token = tok.reshape(1, 1)
+        gstate_dev = jnp.asarray([gstate], dtype=jnp.int32)
+        remaining = jnp.asarray(budget - i, dtype=jnp.int32)
+        stop_ids = set(self.tokenizer.stop_token_ids)
+        s = gstate
+        while i < budget:
+            # clamp the scan to the remaining budget so the final chunk
+            # never runs KV writes past the cache end (the budget already
+            # accounts for max_seq_len)
+            n = min(chunk, budget - i)
+            fused = self._grammar_fused_fn(gen, n)
+            with METRICS.span("grammar_fused_chunk", jax_trace=True):
+                toks, cache, token, rng, gstate_dev, remaining = fused(
+                    self.params, cache, token, rng, gstate_dev, remaining,
+                    table, min_dist,
+                )
+                host = np.asarray(toks)[0].tolist()
+            METRICS.incr("engine.grammar_fused_steps", len(host))
+            for t in host:
+                if i >= budget:
+                    return
+                s = int(grammar.table[s, t]) if s >= 0 else -1
+                if s == grammar.accept:
+                    # a stop token's accept edge ends generation without
+                    # being part of the call text; the closing '}' is
+                    if t not in stop_ids:
+                        yield t
+                    yield from close_ids
+                    return
+                if s < 0:
+                    METRICS.incr("engine.grammar_walked_off")
+                    return  # unreachable under in-scan masking
+                yield t
+                i += 1
+            # chunk ended mid-grammar: token/gstate/remaining carry over
+
     def generate(
         self, prompt_ids: Sequence[int], gen: GenerationConfig | None = None, **kw
     ) -> GenerationResult:

@@ -513,3 +513,193 @@ class TokenGrammar:
 def compile_tool_call_grammar(tool_schema: dict, tokenizer) -> TokenGrammar:
     """Compile one tool's JSON-schema ``input_schema`` into token tables."""
     return TokenGrammar(JsonSchemaGrammar(tool_schema), tokenizer)
+
+
+class ToolCallUnionGrammar(JsonSchemaGrammar):
+    """Char DFA for a complete tool-call object over a REGISTRY of tools:
+
+        {"name":"<registered tool>","arguments":{...that tool's schema...}}
+
+    One DFA serves every registered tool: a trie branch over the tool names
+    (closing quote included, so a name that prefixes another stays
+    unambiguous) continues into that tool's own arguments grammar. This is
+    the generation-side replacement for the reference's post-hoc schema
+    validation (fei/tools/registry.py:92-153): the decoder cannot emit a
+    call that fails validation in the first place.
+    """
+
+    def __init__(self, tools: list[dict]):
+        if not tools:
+            raise EngineError("tool-call grammar needs at least one tool")
+        self.schema = None
+        self.dfa = _DFA()
+        self.accept = self.dfa.new_state()
+        options: list[tuple[bytes, int]] = []
+        seen: set[str] = set()
+        for t in tools:
+            name = t.get("name")
+            if not name:
+                raise EngineError(f"tool without a name: {t!r}")
+            if name in seen:
+                continue
+            seen.add(name)
+            schema = t.get("input_schema") or t.get("parameters") or {}
+            if schema.get("type", "object") != "object":
+                raise EngineError(
+                    f"tool {name!r} input_schema must be an object, "
+                    f"got {schema.get('type')!r}"
+                )
+            close = self.dfa.lit(b"}", self.accept)
+            args_entry = self._object(
+                {"type": "object", **schema}, close
+            )
+            tail = self.dfa.lit(b',"arguments":', args_entry)
+            # the closing quote is part of the branch key: "Glob" vs
+            # "GlobTool" then diverge at ‹"› vs ‹T› instead of colliding
+            options.append((name.encode("utf-8") + b'"', tail))
+        branch = self._branch(options)
+        body = self.dfa.lit(b'{"name":"', branch)
+        # models decorate the trigger tag with newlines ("<tool_call>\n{…")
+        # — the post-hoc parser tolerates \s* there, so the grammar must
+        # too or enforcement would silently disengage on the variant
+        ws = self.dfa.new_state()
+        for b in b" \t\r\n":
+            self.dfa.trans[ws][b] = ws
+        self.dfa.also[ws] = body
+        self.entry = ws
+        self.char_table = self.dfa.char_table()
+
+
+def compile_agent_tool_grammar(tools: list[dict], tokenizer) -> TokenGrammar:
+    """Token-level lift of the whole-registry tool-call grammar."""
+    return TokenGrammar(ToolCallUnionGrammar(tools), tokenizer)
+
+
+def char_walk(grammar: TokenGrammar, text: str, start: int | None = None) -> int:
+    """Walk raw TEXT through the char-level DFA (token boundaries don't
+    matter). Returns the resulting state, or -1 if any byte is illegal.
+    Used to enter the grammar mid-stream: the token that completed the
+    ``<tool_call>`` trigger may have carried extra JSON bytes after it."""
+    s = grammar.entry if start is None else start
+    tab = grammar.grammar.char_table
+    for b in text.encode("utf-8"):
+        if s < 0:
+            return -1
+        s = int(tab[s, b])
+    return s
+
+
+class TriggerScanner:
+    """Incremental detector for a trigger string in streamed token text.
+
+    Each trigger OCCURRENCE is reported exactly once — at the step whose
+    token completes its last character — as the text that followed it in
+    that same step (usually empty; a BPE token can carry the first JSON
+    bytes). A rejected occurrence is never re-examined: once the DFA
+    refuses its suffix, every extension of that suffix is refused too, so
+    re-walking it each step would only inflate metrics and burn host time.
+    O(1) amortized per token; decoding uses a short token context so BPE
+    pieces that merge across boundaries still contribute exact text.
+    """
+
+    def __init__(self, tokenizer, trigger: str, cap: int = 512):
+        self.tok = tokenizer
+        self.trigger = trigger
+        self.ctx: list[int] = []
+        self.text = ""
+        self.search = 0
+        self.cap = max(cap, 4 * len(trigger))
+
+    def feed(self, token_id: int) -> str | None:
+        """Consume one token; return the post-trigger suffix if a NEW
+        trigger occurrence just completed (last one wins), else None."""
+        base = self.tok.decode(self.ctx) if self.ctx else ""
+        piece = self.tok.decode(self.ctx + [token_id])[len(base):]
+        self.ctx = (self.ctx + [token_id])[-8:]
+        if not piece:
+            return None
+        self.text += piece
+        hit: str | None = None
+        pos = self.text.find(self.trigger, self.search)
+        while pos >= 0:
+            hit = self.text[pos + len(self.trigger):]
+            self.search = pos + 1
+            pos = self.text.find(self.trigger, self.search)
+        # never re-scan consumed text, but keep enough tail for a trigger
+        # that is still streaming in
+        self.search = max(self.search, len(self.text) - len(self.trigger) + 1)
+        if len(self.text) > self.cap:
+            drop = len(self.text) - self.cap
+            self.text = self.text[drop:]
+            self.search = max(0, self.search - drop)
+        return hit
+
+
+def toolcall_stream_mask_fn(
+    grammar: TokenGrammar,
+    tokenizer,
+    trigger: str = "<tool_call>",
+    max_tokens: int | None = None,
+):
+    """Stateful ``logit_mask_fn`` enforcing the tool-call protocol on a
+    token stream: free generation until the decoded text emits ``trigger``,
+    then the grammar's masks until the DFA accepts, then stop-tokens only
+    (ending the turn — the agent protocol executes the call and continues
+    in a fresh completion).
+
+    Returns ``(fn, state)``; ``state["accepted"]`` tells the caller whether
+    a complete tool call was emitted (so it can append the close tag).
+    This is the host-mask route used by the paged/continuous-batching path;
+    the dense path fuses the same DFA on device
+    (InferenceEngine.generate_stream_toolcalls).
+    """
+    stop_mask = np.zeros(grammar.mask_table.shape[1], dtype=bool)
+    for sid in tokenizer.stop_token_ids:
+        if sid < stop_mask.shape[0]:
+            stop_mask[sid] = True
+
+    def _fresh() -> dict:
+        return {
+            "len": 0, "mode": "free", "s": -1, "accepted": False,
+            "scanner": TriggerScanner(tokenizer, trigger),
+        }
+
+    state = _fresh()
+
+    def fn(generated: list[int]) -> np.ndarray | None:
+        if len(generated) < state["len"]:
+            state.update(_fresh())
+        new = generated[state["len"]:]
+        state["len"] = len(generated)
+        for t in new:
+            if state["mode"] == "free":
+                suffix = state["scanner"].feed(t)
+                if suffix is not None:
+                    s = char_walk(grammar, suffix)
+                    if s == grammar.accept:  # whole call in one token
+                        state.update(mode="done", accepted=True)
+                    elif s >= 0:
+                        state.update(mode="grammar", s=s)
+            elif state["mode"] == "grammar":
+                s = (
+                    int(grammar.table[state["s"], t])
+                    if state["s"] >= 0 else -1
+                )
+                state["s"] = s
+                if s == grammar.accept:
+                    state.update(mode="done", accepted=True)
+        if state["mode"] == "done":
+            return stop_mask if stop_mask.any() else None
+        if state["mode"] != "grammar" or state["s"] < 0:
+            return None  # free text, or walked off (impossible under masks)
+        s = state["s"]
+        mask = grammar.mask_table[s]
+        if max_tokens is not None:
+            remaining = max_tokens - len(generated)
+            tgt = np.where(grammar.table[s] >= 0, grammar.table[s], 0)
+            feasible = mask & (grammar.min_dist[tgt] <= remaining - 1)
+            if feasible.any():
+                mask = feasible
+        return mask
+
+    return fn, state

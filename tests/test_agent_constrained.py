@@ -1,0 +1,400 @@
+"""Grammar-enforced tool calls on the agent path.
+
+The reference trusts the remote LLM and validates tool-call JSON after the
+fact (fei/tools/registry.py:92-153). Here the decoder is local, so the
+union grammar over every registered tool's input schema is enforced DURING
+generation: a ``<tool_call>`` block cannot be unparseable. These tests
+drive the real engine (random tiny weights — which emit noise precisely
+when unconstrained) through the fused on-device DFA path and the paged
+host-mask path, then the provider/agent loop end-to-end.
+
+The trigger tag is configurable on the provider exactly so these tests can
+use the first token a random-weight model actually emits as the trigger —
+everything downstream (DFA entry, fused scan, close-tag emission, parsing)
+is the production path.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+from fei_tpu.engine.engine import GenerationConfig, InferenceEngine
+from fei_tpu.engine.grammar import (
+    ToolCallUnionGrammar,
+    TokenGrammar,
+    char_walk,
+    compile_agent_tool_grammar,
+)
+from fei_tpu.utils.metrics import METRICS
+
+TOOLS = [
+    {
+        "name": "GlobTool",
+        "description": "find files",
+        "input_schema": {
+            "type": "object",
+            "properties": {
+                "pattern": {"type": "string"},
+                "limit": {"type": "integer"},
+            },
+            "required": ["pattern"],
+        },
+    },
+    {
+        "name": "Glob",  # prefix of GlobTool: trie must not collide
+        "description": "find files (short)",
+        "input_schema": {
+            "type": "object",
+            "properties": {"pattern": {"type": "string"}},
+            "required": ["pattern"],
+        },
+    },
+    {
+        "name": "Shell",
+        "description": "run a command",
+        "input_schema": {
+            "type": "object",
+            "properties": {
+                "command": {"type": "string"},
+                "timeout": {"type": "number"},
+            },
+            "required": ["command"],
+        },
+    },
+]
+
+
+def _walk_text(g, text: str) -> int:
+    return char_walk(g, text)
+
+
+@pytest.fixture(scope="module")
+def grammar():
+    from fei_tpu.engine.tokenizer import load_tokenizer
+
+    return compile_agent_tool_grammar(TOOLS, load_tokenizer("byte"))
+
+
+class TestToolCallUnionGrammar:
+    def test_accepts_every_tool(self, grammar):
+        for call in (
+            '{"name":"GlobTool","arguments":{"pattern":"*.py","limit":5}}',
+            '{"name":"Glob","arguments":{"pattern":"src/**"}}',
+            '{"name":"Shell","arguments":{"command":"ls -la","timeout":2.5}}',
+        ):
+            assert _walk_text(grammar, call) == grammar.accept, call
+
+    def test_optional_property_skippable(self, grammar):
+        ok = '{"name":"GlobTool","arguments":{"pattern":"x"}}'
+        assert _walk_text(grammar, ok) == grammar.accept
+
+    def test_rejects_unknown_tool_and_bad_shapes(self, grammar):
+        bad = [
+            '{"name":"Nope","arguments":{}}',  # unregistered tool
+            '{"name":"Glob","arguments":{}}',  # missing required property
+            '{"name":"GlobTool","arguments":{"limit":"five"',  # wrong type
+            '{"arguments":{},"name":"Glob"}',  # wrong property order
+            '{"name":"Shell","arguments":{"command":1}}',  # wrong type
+        ]
+        for call in bad:
+            state = _walk_text(grammar, call)
+            assert state != grammar.accept, call
+            # every bad call must become unreachable mid-walk, not merely
+            # unfinished: append nothing and check no continuation exists
+            # only for the truly-rejected ones (-1)
+        assert _walk_text(grammar, '{"name":"Nope"') == -1
+
+    def test_tool_without_object_schema_raises(self):
+        from fei_tpu.utils.errors import EngineError
+
+        with pytest.raises(EngineError):
+            ToolCallUnionGrammar(
+                [{"name": "x", "input_schema": {"type": "string"}}]
+            )
+
+    def test_min_dist_entry_finite(self, grammar):
+        assert grammar.min_dist[grammar.entry] < (1 << 20)
+
+    def test_whitespace_after_trigger_still_enforced(self, grammar):
+        # "<tool_call>\n{...}" is a common emission variant the post-hoc
+        # parser tolerates; the grammar must accept it too, or enforcement
+        # would silently disengage exactly when a real model adds a newline
+        ws_call = '\n {"name":"Glob","arguments":{"pattern":"x"}}'
+        assert _walk_text(grammar, ws_call) == grammar.accept
+
+
+class TestTriggerScanner:
+    def test_each_occurrence_reported_once(self):
+        from fei_tpu.engine.grammar import TriggerScanner
+        from fei_tpu.engine.tokenizer import load_tokenizer
+
+        tok = load_tokenizer("byte")
+        sc = TriggerScanner(tok, "<T>")
+        hits = []
+        for ch in "ab<T>xy<T>z":
+            for i in tok.encode(ch):
+                h = sc.feed(i)
+                if h is not None:
+                    hits.append(h)
+        # two occurrences; each reported exactly once, at completion, with
+        # the (empty) same-step suffix — later tokens never re-report
+        assert hits == ["", ""]
+
+    def test_suffix_carried_by_completing_token(self):
+        from fei_tpu.engine.grammar import TriggerScanner
+
+        class WordTok:
+            def decode(self, ids):
+                return "".join(chr(i) for i in ids)
+
+        sc = TriggerScanner(WordTok(), "<T")
+        # one "token" carrying the trigger end plus JSON bytes
+        out = [sc.feed(ord(c)) for c in "<"]
+        assert out == [None]
+        # feed a multi-char piece via a custom decode: simulate by chars
+        got = None
+        for c in "T{w":
+            h = sc.feed(ord(c))
+            if h is not None:
+                got = h
+        assert got == ""  # completed at 'T', suffix arrives as later chars
+
+
+def _prompt_and_trigger(engine, gen) -> tuple[list[int], str]:
+    """A (prompt, trigger) pair this model will actually hit: the trigger
+    is the first token the unconstrained model emits for the prompt.
+    Greedy decoding ignores the seed, so we vary the PROMPT until the first
+    emitted token is clean printable ASCII that round-trips encode(decode).
+    """
+    for base in range(5, 80, 3):
+        prompt = [base, base + 1, base + 2, base + 3]
+        first = next(iter(engine.generate_stream(prompt, gen)), None)
+        if first is None:
+            continue
+        text = engine.tokenizer.decode([first])
+        if (
+            len(text) == 1
+            and text.isprintable()
+            and engine.tokenizer.encode(text) == [first]
+        ):
+            return prompt, text
+    pytest.skip("no prompt yields a clean ASCII first token for this model")
+
+
+class TestEngineToolcallStream:
+    def test_fused_constrained_call_parses(self):
+        engine = InferenceEngine.from_config("tiny")
+        gen = GenerationConfig(max_new_tokens=96, ignore_eos=True)
+        grammar = compile_agent_tool_grammar(TOOLS, engine.tokenizer)
+        prompt, trigger = _prompt_and_trigger(engine, gen)
+        before = METRICS.snapshot()["counters"].get(
+            "engine.grammar_fused_steps", 0
+        )
+        toks = list(
+            engine.generate_stream_toolcalls(
+                prompt, gen, grammar=grammar, trigger=trigger
+            )
+        )
+        after = METRICS.snapshot()["counters"].get(
+            "engine.grammar_fused_steps", 0
+        )
+        assert after > before, "fused on-device DFA scan did not run"
+        text = engine.tokenizer.decode(toks)
+        assert text.startswith(trigger)
+        assert text.endswith("</tool_call>")
+        payload = text[len(trigger):-len("</tool_call>")]
+        obj = json.loads(payload)  # grammar guarantee: always parseable
+        assert obj["name"] in {t["name"] for t in TOOLS}
+        assert isinstance(obj["arguments"], dict)
+        # and the emitted payload walks the DFA to accept
+        assert char_walk(grammar, payload) == grammar.accept
+
+    def test_fused_matches_host_mask_reference(self):
+        """The fused scan's tokens equal the host-masked dense reference
+        (generate_stream with grammar.logit_mask_fn) from the same state."""
+        engine = InferenceEngine.from_config("tiny")
+        gen = GenerationConfig(max_new_tokens=64, ignore_eos=True)
+        grammar = compile_agent_tool_grammar(TOOLS, engine.tokenizer)
+        prompt, trigger = _prompt_and_trigger(engine, gen)
+        toks = list(
+            engine.generate_stream_toolcalls(
+                prompt, gen, grammar=grammar, trigger=trigger
+            )
+        )
+        text = engine.tokenizer.decode(toks)
+        payload = text[len(trigger):-len("</tool_call>")]
+
+        # host-mask reference: same prompt, mask applied per token on host;
+        # ignore_eos off so the stop token sampled at accept ends it. The
+        # fused path spent 1 of its 64-token budget on the trigger token,
+        # so the reference's feasibility budget is 63
+        ref = engine.generate(
+            prompt + engine.tokenizer.encode(trigger),
+            GenerationConfig(max_new_tokens=63),
+            logit_mask_fn=grammar.logit_mask_fn(max_tokens=63),
+        )
+        ref_payload = ref.text
+        # both are full valid tool calls; greedy ⇒ identical token choices
+        assert char_walk(grammar, ref_payload) == grammar.accept
+        assert payload == ref_payload, (payload, ref_payload)
+
+    def test_budget_too_small_truncates_cleanly(self):
+        engine = InferenceEngine.from_config("tiny")
+        gen = GenerationConfig(max_new_tokens=6, ignore_eos=True)
+        grammar = compile_agent_tool_grammar(TOOLS, engine.tokenizer)
+        prompt, trigger = _prompt_and_trigger(engine, gen)
+        toks = list(
+            engine.generate_stream_toolcalls(
+                prompt, gen, grammar=grammar, trigger=trigger
+            )
+        )
+        text = engine.tokenizer.decode(toks)
+        # no room for a complete call: the stream must not emit a partial
+        # close tag or a broken block — either no trigger continuation or
+        # nothing beyond the free tokens
+        assert "</tool_call>" not in text or char_walk(
+            grammar, text.split(trigger, 1)[1][: -len("</tool_call>")]
+        ) == grammar.accept
+
+    def test_paged_masked_call_parses(self):
+        engine = InferenceEngine.from_config(
+            "tiny", paged=True, batch_size=2
+        )
+        grammar = compile_agent_tool_grammar(TOOLS, engine.tokenizer)
+        probe_gen = GenerationConfig(max_new_tokens=8, ignore_eos=True)
+        prompt, trigger = _prompt_and_trigger(engine, probe_gen)
+        gen = GenerationConfig(max_new_tokens=96)
+        toks = list(
+            engine.generate_stream_toolcalls(
+                prompt, gen, grammar=grammar, trigger=trigger
+            )
+        )
+        text = engine.tokenizer.decode(toks)
+        if trigger in text and text.endswith("</tool_call>"):
+            payload = text.split(trigger, 1)[1][: -len("</tool_call>")]
+            obj = json.loads(payload)
+            assert obj["name"] in {t["name"] for t in TOOLS}
+            assert char_walk(grammar, payload) == grammar.accept
+        else:
+            # the model stopped before emitting the trigger — legal, but
+            # then no tool-call fragment may appear at all
+            assert "</tool_call>" not in text
+
+
+def _provider_trigger(provider, messages, system, tools) -> str:
+    """Fix-point probe: the trigger the model will emit for the provider's
+    EXACT prompt. The trigger itself appears in the rendered tool prompt
+    (render_tool_prompt teaches the emission protocol with it), so changing
+    it changes the prompt — iterate until the model's greedy prefix for the
+    prompt-containing-the-trigger IS the trigger."""
+    def prefix_for() -> str:
+        full = provider._messages_with_system(messages, system, tools)
+        ids = provider.engine.tokenizer.apply_chat_template(
+            full, add_generation_prompt=True
+        )
+        gen = provider._GenerationConfig(
+            max_new_tokens=8, **provider.gen_overrides
+        )
+        toks: list[int] = []
+        for tok in provider.engine.generate_stream(ids, gen):
+            toks.append(tok)
+            if len(toks) >= 8:
+                break
+        return provider.engine.tokenizer.decode(toks)
+
+    for _ in range(8):
+        text = prefix_for()
+        if not text:
+            break
+        if text == provider.tool_trigger:
+            return text
+        provider.tool_trigger = text
+    return None  # no fixed point for this prompt; caller varies the message
+
+
+class TestProviderConstrained:
+    def _provider(self, paged: bool = False):
+        from fei_tpu.agent.providers import JaxLocalProvider
+
+        engine = InferenceEngine.from_config(
+            "tiny", paged=paged, batch_size=2 if paged else 1
+        )
+        return JaxLocalProvider(engine=engine,
+                                gen_overrides={"ignore_eos": True})
+
+    def test_tool_turn_cannot_produce_unparseable_json(self):
+        provider = self._provider()
+        messages = None
+        for content in ("list the python files", "grep for TODO", "run ls",
+                        "open README", "count the tests"):
+            cand = [{"role": "user", "content": content}]
+            if _provider_trigger(provider, cand, None, TOOLS):
+                messages = cand
+                break
+        if messages is None:
+            pytest.skip("no prompt converges to a fixed-point trigger")
+        assert provider.constrain_tools is True  # default ON
+        before = METRICS.snapshot()["counters"].get(
+            "engine.grammar_fused_steps", 0
+        )
+        resp = provider.complete(messages, tools=TOOLS, max_tokens=96)
+        after = METRICS.snapshot()["counters"].get(
+            "engine.grammar_fused_steps", 0
+        )
+        assert after > before, "provider did not run the fused grammar path"
+        assert resp.stop_reason == "tool_use"
+        assert len(resp.tool_calls) == 1
+        call = resp.tool_calls[0]
+        assert call.name in {t["name"] for t in TOOLS}
+        assert isinstance(call.arguments, dict)
+        # schema guarantee, not parser luck: required args are present
+        schema = next(
+            t["input_schema"] for t in TOOLS if t["name"] == call.name
+        )
+        for req in schema.get("required", []):
+            assert req in call.arguments
+
+    def test_agent_loop_executes_constrained_call(self):
+        import asyncio
+
+        from fei_tpu.agent import Assistant
+        from fei_tpu.tools import ToolRegistry
+
+        provider = self._provider()
+        seen: list[dict] = []
+        registry = ToolRegistry()
+        for t in TOOLS:
+            registry.register_tool(
+                t["name"], t["description"], t["input_schema"],
+                lambda _seen=seen, **kw: (_seen.append(kw) or {"ok": True}),
+            )
+        assistant = Assistant(
+            provider=provider, tool_registry=registry,
+            max_tokens=96, max_tool_rounds=1,
+        )
+        message = None
+        for content in ("find the tests", "search the repo", "what files",
+                        "look around", "scan for bugs", "check the docs"):
+            ok = _provider_trigger(
+                provider,
+                [{"role": "user", "content": content}],
+                assistant.system_prompt,
+                assistant.tool_manager.get_tools(),
+            )
+            if ok:
+                message = content
+                break
+        if message is None:
+            pytest.skip("no prompt converges to a fixed-point trigger")
+        asyncio.run(assistant.chat(message))
+        # the constrained call validated against the registry schema and
+        # EXECUTED — the arguments object was never re-parsed from freetext
+        assert seen, "no tool executed from the constrained call"
+
+    def test_constrain_tools_off_restores_posthoc(self):
+        provider = self._provider()
+        provider.constrain_tools = False
+        assert provider._tool_grammar(TOOLS) is None
