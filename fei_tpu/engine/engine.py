@@ -279,16 +279,13 @@ class InferenceEngine:
                     logits, cache = fwd(params, cfg, token, cache)
                     logits = logits[:, -1, :]
 
+                    from fei_tpu.engine.grammar import feasible_mask
+
                     row = table[gstate]  # [B, V]
-                    legal = row >= 0
-                    tgt = jnp.where(legal, row, 0)
-                    feasible = jnp.logical_and(
-                        legal, min_dist[tgt] <= remaining - 1
+                    mask = feasible_mask(
+                        row, min_dist,
+                        jnp.broadcast_to(remaining, row.shape[:1]), xp=jnp,
                     )
-                    # if feasibility empties a row (shouldn't, inductively),
-                    # fall back to plain legality rather than all -inf
-                    has_feasible = feasible.any(axis=-1, keepdims=True)
-                    mask = jnp.where(has_feasible, feasible, legal)
                     logits = jnp.where(mask, logits, -jnp.inf)
 
                     rng, sub = jax.random.split(rng)
@@ -332,23 +329,30 @@ class InferenceEngine:
         stops = self._stops(gen)
         budget = min(gen.max_new_tokens, self.max_seq_len - len(prompt_ids))
         if self.paged:
-            # paged + constrained: the scheduler applies the grammar as a
-            # per-step host mask, so constrained tool calls batch with every
-            # other in-flight sequence (same tokens as the device scan —
-            # tests assert parity with the dense path)
-            return self.generate(
-                prompt_ids, gen, logit_mask_fn=grammar.logit_mask_fn(budget)
-            )
+            # paged + constrained: DEVICE-NATIVE in the scheduler — the DFA
+            # mask is computed inside the batched step from per-slot [B]
+            # states, so constrained requests batch with every other
+            # in-flight sequence with ZERO per-step host mask uploads
+            # (tests assert parity with the dense fused scan)
+            t0 = time.perf_counter()
+            ttft = None
+            out: list[int] = []
+            for tok in self.scheduler.stream(prompt_ids, gen, grammar=grammar):
+                if ttft is None:
+                    ttft = time.perf_counter() - t0
+                out.append(tok)
+            total = time.perf_counter() - t0
+            return self._make_result(out, len(prompt_ids), ttft or 0.0, total)
         t0 = time.perf_counter()
         table, min_dist = grammar.device_tables(self.cfg.vocab_size)
 
         # first token: prefill logits masked by the entry row, with the same
         # budget-feasibility rule the device scan applies
-        row = grammar.table[grammar.entry]
-        legal = row >= 0
-        tgt = np.where(legal, row, 0)
-        feasible = legal & (grammar.min_dist[tgt] <= budget - 1)
-        entry_mask = self._pad_mask(feasible if feasible.any() else legal)
+        from fei_tpu.engine.grammar import feasible_mask
+
+        entry_mask = self._pad_mask(
+            feasible_mask(grammar.table[grammar.entry], grammar.min_dist, budget)
+        )
         tok, cache, rng = self._prefill_sample(prompt_ids, gen, entry_mask)
         slots_left = self.max_seq_len - len(prompt_ids) - 1
         first = int(tok[0])
@@ -621,20 +625,18 @@ class InferenceEngine:
         if grammar is None:
             yield from self.generate_stream(prompt_ids, gen)
             return
-        from fei_tpu.engine.grammar import (
-            TriggerScanner,
-            char_walk,
-            toolcall_stream_mask_fn,
-        )
+        from fei_tpu.engine.grammar import TriggerScanner, char_walk
 
         close_ids = self.tokenizer.encode(close)
         budget = min(gen.max_new_tokens, self.max_seq_len - len(prompt_ids))
         if self.paged:
-            fn, mstate = toolcall_stream_mask_fn(
-                grammar, self.tokenizer, trigger, max_tokens=budget,
+            # device-native in the scheduler: free decode until the trigger,
+            # then the DFA constrains inside the batched step program
+            seq = self.scheduler.submit(
+                prompt_ids, gen, grammar=grammar, grammar_trigger=trigger
             )
-            yield from self.scheduler.stream(prompt_ids, gen, fn)
-            if mstate["accepted"]:
+            yield from self.scheduler.drain(seq)
+            if seq.gaccepted:
                 yield from close_ids
             return
 

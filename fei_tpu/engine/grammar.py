@@ -494,18 +494,16 @@ class TokenGrammar:
             s = state["s"]
             if s < 0:
                 return None  # constraint already violated; stop masking
-            mask = self.mask_table[s]
             if max_tokens is not None:
                 # budget feasibility per edge: a token is only legal if its
                 # target can still reach accept within the remaining budget.
                 # Inductively dist[s] <= remaining, so the shortest-path edge
                 # always survives — generation can never strand mid-grammar.
-                remaining = max_tokens - len(generated)
-                tgt = np.where(self.table[s] >= 0, self.table[s], 0)
-                feasible = mask & (self.min_dist[tgt] <= remaining - 1)
-                if feasible.any():
-                    mask = feasible
-            return mask
+                return feasible_mask(
+                    self.table[s], self.min_dist,
+                    max_tokens - len(generated),
+                )
+            return self.mask_table[s]
 
         return fn
 
@@ -573,6 +571,27 @@ class ToolCallUnionGrammar(JsonSchemaGrammar):
 def compile_agent_tool_grammar(tools: list[dict], tokenizer) -> TokenGrammar:
     """Token-level lift of the whole-registry tool-call grammar."""
     return TokenGrammar(ToolCallUnionGrammar(tools), tokenizer)
+
+
+def feasible_mask(row, min_dist, remaining, xp=np):
+    """The ONE budget-feasibility masking rule, shared by every host and
+    device call site (dense fused scan, scheduler step program, first-token
+    masks, host mask fns): a token is legal iff its DFA edge exists AND its
+    target state can still reach accept within ``remaining - 1`` further
+    tokens. Falls back to plain legality if feasibility empties the row
+    (inductively impossible mid-walk; defensive at entry).
+
+    ``row``: one table row [V] or a batch [B, V]; ``remaining``: scalar or
+    [B]; ``xp``: np for host masks, jnp inside compiled programs.
+    """
+    legal = row >= 0
+    tgt = xp.where(legal, row, 0).astype(xp.int32)
+    rem = remaining - 1
+    if getattr(row, "ndim", 1) == 2:
+        rem = rem[:, None]
+    feasible = xp.logical_and(legal, min_dist[tgt] <= rem)
+    has = feasible.any(axis=-1, keepdims=getattr(row, "ndim", 1) == 2)
+    return xp.where(has, feasible, legal)
 
 
 def char_walk(grammar: TokenGrammar, text: str, start: int | None = None) -> int:
@@ -693,13 +712,11 @@ def toolcall_stream_mask_fn(
         if state["mode"] != "grammar" or state["s"] < 0:
             return None  # free text, or walked off (impossible under masks)
         s = state["s"]
-        mask = grammar.mask_table[s]
         if max_tokens is not None:
-            remaining = max_tokens - len(generated)
-            tgt = np.where(grammar.table[s] >= 0, grammar.table[s], 0)
-            feasible = mask & (grammar.min_dist[tgt] <= remaining - 1)
-            if feasible.any():
-                mask = feasible
-        return mask
+            return feasible_mask(
+                grammar.table[s], grammar.min_dist,
+                max_tokens - len(generated),
+            )
+        return grammar.mask_table[s]
 
     return fn, state

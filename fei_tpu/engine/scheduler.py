@@ -76,6 +76,18 @@ class _Seq:
     # chain over the whole prompt is O(n) — computing it once per request
     # instead of once per admission retry keeps the scheduler lock cheap.
     prefix_match: list[int] | None = None
+    # device-native grammar constraint (engine.grammar.TokenGrammar): the
+    # DFA mask is computed INSIDE the step program from a [B] state vector
+    # — no per-step [B, vocab] host mask upload. ``gstate`` is the host
+    # mirror (-1 = unconstrained / watching for the trigger).
+    grammar: object | None = None
+    gtrigger: str | None = None
+    gscanner: object | None = None
+    gstate: int = -1
+    gaccepted: bool = False
+    # host-mask fallback state (second distinct grammar in flight): the
+    # toolcall masker's dict, whose "accepted" flag folds into gaccepted
+    gfallback_state: dict | None = None
 
 
 class PagedScheduler:
@@ -109,6 +121,13 @@ class PagedScheduler:
         self._admitting: dict | None = None  # in-flight chunked admission
         self._prefix = None  # PrefixCache when engine.prefix_cache
         self._gather_jit: dict = {}
+        # active device grammar: ONE table pair serves every constrained
+        # request (the agent memoizes one union grammar per tool set); a
+        # second distinct grammar falls back to host masks until the first
+        # drains. The strong ref keeps id() stable.
+        self._ggrammar = None
+        self._gtable = None
+        self._gmind = None
 
     # -- public API ---------------------------------------------------------
 
@@ -117,13 +136,22 @@ class PagedScheduler:
         prompt_ids: Sequence[int],
         gen,
         logit_mask_fn: Callable[[list[int]], np.ndarray | None] | None = None,
+        grammar=None,
+        grammar_trigger: str | None = None,
     ) -> Iterator[int]:
         """Submit a request and yield its tokens as they decode.
 
         Closing the iterator (or abandoning it to GC) cancels the request
         and returns its pages/slot to the pool — an abandoned stream can
         never wedge the engine (round-1 advisory)."""
-        seq = self.submit(prompt_ids, gen, logit_mask_fn)
+        seq = self.submit(
+            prompt_ids, gen, logit_mask_fn,
+            grammar=grammar, grammar_trigger=grammar_trigger,
+        )
+        yield from self.drain(seq)
+
+    def drain(self, seq: _Seq) -> Iterator[int]:
+        """Yield a submitted request's tokens; cancel on close/GC."""
         try:
             while True:
                 item = seq.out.get()
@@ -135,7 +163,16 @@ class PagedScheduler:
         finally:
             self.cancel(seq)
 
-    def submit(self, prompt_ids, gen, logit_mask_fn=None) -> _Seq:
+    def submit(
+        self, prompt_ids, gen, logit_mask_fn=None,
+        grammar=None, grammar_trigger: str | None = None,
+    ) -> _Seq:
+        """``grammar`` (a TokenGrammar) runs DEVICE-NATIVE: the DFA mask is
+        computed inside the compiled step from per-slot states — unlike
+        ``logit_mask_fn`` there is no per-step host mask evaluation or
+        [B, vocab] upload. With ``grammar_trigger`` the request decodes
+        freely until the trigger text appears, then constrains (the agent
+        tool-call protocol); without it the whole output is constrained."""
         eng = self.engine
         n = len(prompt_ids)
         if n > eng.max_seq_len:
@@ -159,11 +196,80 @@ class PagedScheduler:
             stops=eng._stops(gen),
             budget=budget,
         )
-        with self._lock:
-            self._waiting.append(seq)
-            self._start_thread()
+        appended = False
+        if grammar is not None:
+            if seq.mask_fn is not None:
+                raise EngineError(
+                    "grammar and logit_mask_fn are mutually exclusive"
+                )
+            prebuilt = None
+            if self._ggrammar is not grammar:
+                # build the [S, V] device tables OUTSIDE the lock — a
+                # multi-tool union over a 128k tile-rounded vocab is a
+                # large host→device upload and must not stall the
+                # scheduler loop's token delivery
+                prebuilt = grammar.device_tables(eng.cfg.vocab_size)
+            with self._lock:
+                if self._set_grammar(grammar, prebuilt):
+                    seq.grammar = grammar
+                    seq.gtrigger = grammar_trigger
+                    if grammar_trigger is None:
+                        seq.gstate = grammar.entry
+                    else:
+                        from fei_tpu.engine.grammar import TriggerScanner
+
+                        seq.gscanner = TriggerScanner(
+                            eng.tokenizer, grammar_trigger
+                        )
+                    # queue in the SAME critical section as the install: a
+                    # concurrent submit of a different grammar must see
+                    # this request in flight, or it could swap the device
+                    # table out from under our host DFA mirror
+                    self._waiting.append(seq)
+                    self._start_thread()
+                    appended = True
+            if not appended:
+                # a different grammar is in flight: serve this request with
+                # the equivalent host mask rather than rejecting it
+                log.info(
+                    "second distinct grammar in flight; request falls back "
+                    "to host-mask constrained decode"
+                )
+                if grammar_trigger is None:
+                    seq.mask_fn = grammar.logit_mask_fn(max_tokens=budget)
+                else:
+                    from fei_tpu.engine.grammar import toolcall_stream_mask_fn
+
+                    fn, mstate = toolcall_stream_mask_fn(
+                        grammar, eng.tokenizer, grammar_trigger,
+                        max_tokens=budget,
+                    )
+                    seq.mask_fn = fn
+                    seq.gfallback_state = mstate
+        if not appended:
+            with self._lock:
+                self._waiting.append(seq)
+                self._start_thread()
         self._wake.set()
         return seq
+
+    def _set_grammar(self, grammar, prebuilt=None) -> bool:
+        """Install ``grammar`` as the device-native one. Returns False when
+        a DIFFERENT grammar still has in-flight requests (caller must fall
+        back to host masks). Called under self._lock; ``prebuilt`` device
+        tables come from the caller so the upload happens outside it."""
+        if self._ggrammar is grammar:
+            return True
+        inflight = any(
+            s is not None and s.grammar is not None for s in self._slots
+        ) or any(s.grammar is not None for s in self._waiting)
+        if self._ggrammar is not None and inflight:
+            return False
+        if prebuilt is None:
+            prebuilt = grammar.device_tables(self.engine.cfg.vocab_size)
+        self._gtable, self._gmind = prebuilt
+        self._ggrammar = grammar
+        return True
 
     def cancel(self, seq: _Seq) -> None:
         with self._lock:
@@ -476,6 +582,10 @@ class PagedScheduler:
         alloc = eng._allocator
         n = len(seq.prompt_ids)
         mask = self._host_mask(seq, first=True)
+        if mask is None and seq.grammar is not None and seq.gstate >= 0:
+            # the first token samples from prefill logits outside the step
+            # program — one [V] mask per REQUEST at admission, not per step
+            mask = self._grammar_first_mask(seq)
         if mask is not None:
             last_logits = jnp.where(jnp.asarray(mask)[None, :], last_logits, -jnp.inf)
         rng = jax.random.PRNGKey(seq.gen.seed)
@@ -508,12 +618,63 @@ class PagedScheduler:
         if self._prefix is not None:
             self._prefix.register(seq.prompt_ids, pages[:n_prompt_pages])
 
-        if seq.budget <= 0 or tok0 in seq.stops:
+        if seq.budget <= 0:
             self._finish(seq)
             return
-        seq.generated.append(tok0)
-        seq.out.put(tok0)
-        seq.next_input = tok0
+        self._deliver(seq, tok0)
+
+    def _grammar_advance(self, seq: _Seq, t: int) -> tuple[bool, bool]:
+        """Advance the host DFA mirror with sampled token ``t``.
+        Returns (emit_token, finish_now). The device step applied the same
+        table, so the mirror walk can only land where the mask allowed."""
+        from fei_tpu.engine.grammar import char_walk
+
+        g = seq.grammar
+        if seq.gstate < 0:
+            # free phase: watch the streamed text for the trigger
+            suffix = seq.gscanner.feed(t)
+            if suffix is not None:
+                s = char_walk(g, suffix)
+                if s == g.accept:  # whole call inside the trigger token
+                    seq.gaccepted = True
+                    return True, True
+                if s >= 0:
+                    seq.gstate = s
+                else:
+                    METRICS.incr("scheduler.grammar_trigger_suffix_rejected")
+            return True, False
+        nxt = int(g.table[seq.gstate, t])
+        if nxt < 0:
+            METRICS.incr("scheduler.grammar_walked_off")
+            return True, False  # unreachable under the device mask
+        seq.gstate = nxt
+        if nxt == g.accept and seq.gtrigger is not None:
+            # tool-call protocol: the turn ends at acceptance. A stop
+            # token's accept edge is not part of the call text.
+            seq.gaccepted = True
+            return t not in seq.stops and t not in set(
+                self.engine.tokenizer.stop_token_ids
+            ), True
+        return True, False
+
+    def _deliver(self, seq: _Seq, t: int) -> None:
+        """Handle one sampled token for an armed sequence — grammar walk,
+        stop handling, emission, completion. Shared by the admission first
+        token and every decode step."""
+        if seq.grammar is not None:
+            emit, done = self._grammar_advance(seq, t)
+        else:
+            emit, done = True, False
+        if not done and t in seq.stops:
+            self._finish(seq)
+            return
+        if emit:
+            seq.generated.append(t)
+            seq.out.put(t)
+        if done:
+            self._finish(seq)
+            return
+        seq.next_input = t
         if len(seq.generated) >= seq.budget:
             self._finish(seq)
 
@@ -544,7 +705,10 @@ class PagedScheduler:
         temps = np.zeros((B,), dtype=np.float32)
         topks = np.zeros((B,), dtype=np.int32)
         topps = np.ones((B,), dtype=np.float32)
+        gstates = np.full((B,), -1, dtype=np.int32)
+        gremain = np.zeros((B,), dtype=np.int32)
         masked = bool(masks)
+        grammared = False
         mask = np.ones((B, V), dtype=bool) if masked else None
         for b, s in enumerate(self._slots):
             if s is None or s.prefilling:
@@ -555,31 +719,41 @@ class PagedScheduler:
             topps[b] = s.gen.top_p
             if masked and b in masks:
                 mask[b] = masks[b]
+            if s.grammar is not None and s.gstate >= 0:
+                # the [B] state/budget vectors ride the same upload as the
+                # token ids; the [S, V] table never leaves the device
+                gstates[b] = s.gstate
+                gremain[b] = s.budget - len(s.generated)
+                grammared = True
 
-        step = self._step_fn(masked)
+        if masked:
+            # every host-evaluated mask pays a [B, V] upload — the metric
+            # the device-native grammar path is measured against
+            METRICS.incr("scheduler.host_mask_uploads", len(masks))
+        step = self._step_fn(masked, grammared)
         args = [eng.params, self._pool, jnp.asarray(tokens), self._keys,
                 jnp.asarray(temps), jnp.asarray(topks), jnp.asarray(topps)]
+        kw = {}
+        if grammared:
+            kw.update(
+                gstates=jnp.asarray(gstates), gremain=jnp.asarray(gremain),
+                table=self._gtable, mind=self._gmind,
+            )
         if masked:
-            args.append(jnp.asarray(mask))
+            kw["mask"] = jnp.asarray(mask)
         with METRICS.span("decode_step"):
-            nxt, self._pool, self._keys = step(*args)
+            nxt, self._pool, self._keys = step(*args, **kw)
             toks = np.asarray(nxt)  # host sync inside the span
 
         for b, s in list(enumerate(self._slots)):
             if s is None or s.prefilling:
                 continue
-            t = int(toks[b])
-            if t in s.stops:
-                self._finish(s)
-                continue
-            s.generated.append(t)
-            s.out.put(t)
-            s.next_input = t
-            if len(s.generated) >= s.budget:
-                self._finish(s)
+            self._deliver(s, int(toks[b]))
 
     def _finish(self, seq: _Seq) -> None:
         seq.finished = True
+        if seq.gfallback_state is not None:
+            seq.gaccepted = bool(seq.gfallback_state.get("accepted"))
         slot = seq.slot
         if slot >= 0 and self._slots[slot] is seq:
             if self._evict_jit is None:
@@ -638,6 +812,16 @@ class PagedScheduler:
                     from fei_tpu.engine.paged_cache import PrefixCache
 
                     self._prefix = PrefixCache(self.engine._allocator)
+
+    def _grammar_first_mask(self, seq: _Seq) -> np.ndarray:
+        """Entry-state mask (with the dense path's budget-feasibility rule)
+        for a device-grammar request's first sampled token."""
+        from fei_tpu.engine.engine import pad_vocab_mask
+        from fei_tpu.engine.grammar import feasible_mask
+
+        g = seq.grammar
+        m = feasible_mask(g.table[seq.gstate], g.min_dist, seq.budget)
+        return pad_vocab_mask(m, self.engine.cfg.vocab_size, xp=np)
 
     def _host_mask(self, seq: _Seq, first: bool = False) -> np.ndarray | None:
         if seq.mask_fn is None:
@@ -726,17 +910,31 @@ class PagedScheduler:
             self._admit_jit[key] = jax.jit(admit, donate_argnums=(0,))
         return self._admit_jit[key]
 
-    def _step_fn(self, masked: bool):
-        key = (masked,)
+    def _step_fn(self, masked: bool, grammared: bool = False):
+        key = (masked, grammared)
         if key not in self._step_jit:
             cfg = self.engine.cfg
             mesh = self.engine.mesh  # tp mesh: kernel runs via shard_map
 
-            def step(params, pool, tokens, keys, temps, topks, topps, mask=None):
+            def step(params, pool, tokens, keys, temps, topks, topps,
+                     gstates=None, gremain=None, table=None, mind=None,
+                     mask=None):
                 logits, pool = forward_paged(
                     params, cfg, tokens, pool, kernel_mesh=mesh
                 )
                 logits = logits[:, -1, :]
+                if grammared:
+                    # per-slot DFA mask, entirely on device: slots with
+                    # gstate < 0 (free/unconstrained) pass through. Budget
+                    # feasibility is the shared rule (grammar.feasible_mask,
+                    # same as the dense fused scan).
+                    from fei_tpu.engine.grammar import feasible_mask
+
+                    use = gstates >= 0
+                    srow = table[jnp.maximum(gstates, 0)]  # [B, V]
+                    gmask = feasible_mask(srow, mind, gremain, xp=jnp)
+                    gmask = jnp.where(use[:, None], gmask, True)
+                    logits = jnp.where(gmask, logits, -jnp.inf)
                 if masked:
                     logits = jnp.where(mask, logits, -jnp.inf)
                 outs = jax.vmap(jax.random.split)(keys)  # [B, 2, 2]
