@@ -101,6 +101,7 @@ class InferenceEngine:
         num_pages: int | None = None,
         kv_quant: str | None = None,
         prefix_cache: bool = False,
+        long_prefill_min: int | None = None,
     ):
         self.cfg = model_cfg
         self.params = params
@@ -141,6 +142,15 @@ class InferenceEngine:
         self._prefill_cache: dict[tuple, Callable] = {}
         self._step_cache: dict[tuple, Callable] = {}
         self._fused_cache: dict[tuple, Callable] = {}
+        # prompts at least this long prefill SEQUENCE-SHARDED over the
+        # mesh's sp axis (ring attention full-model, parallel.long_prefill)
+        # instead of serially — the agent loop's unbounded conversations
+        # (reference fei/core/task_executor.py:231-252) are the workload
+        import os as _os
+
+        self.long_prefill_min = long_prefill_min if long_prefill_min is not None \
+            else int(_os.environ.get("FEI_TPU_LONG_PREFILL_MIN", "2048"))
+        self._sp_prefill_jit: Callable | None = None
 
     # -- construction -------------------------------------------------------
 
@@ -162,6 +172,7 @@ class InferenceEngine:
         quantize: str | None = None,
         kv_quant: str | None = None,
         prefix_cache: bool = False,
+        long_prefill_min: int | None = None,
         **overrides,
     ) -> "InferenceEngine":
         """``quantize="int8"`` converts the big linear weights to weight-only
@@ -190,6 +201,7 @@ class InferenceEngine:
             max_seq_len=max_seq_len, batch_size=batch_size, dtype=dtype,
             paged=paged, page_size=page_size, num_pages=num_pages,
             kv_quant=kv_quant, prefix_cache=prefix_cache,
+            long_prefill_min=long_prefill_min,
         )
         if mesh is not None:
             from fei_tpu.parallel.sharding import shard_engine
@@ -528,9 +540,58 @@ class InferenceEngine:
             prompt_tokens=prompt_len,
         )
 
+    def _sp_prefill_eligible(self, n_tokens: int) -> bool:
+        """True when this prompt WILL prefill sequence-sharded: the mesh has
+        a real sp axis, the prompt meets the length threshold, and the
+        padded bucket divides over the axis. One guard shared by
+        ``prefill`` and the scheduler's admission routing, so the two can
+        never disagree (a prompt that skipped chunking must not fall
+        through to one monolithic dense prefill)."""
+        if (
+            self.mesh is None
+            or "sp" not in self.mesh.axis_names
+            or self.mesh.shape["sp"] <= 1
+            or n_tokens < self.long_prefill_min
+        ):
+            return False
+        bucket = min(_next_bucket(n_tokens), self.max_seq_len)
+        return bucket % self.mesh.shape["sp"] == 0
+
+    def _sp_prefill_fn(self):
+        """Compiled sequence-sharded full-model prefill into a caller cache
+        (ring attention over the sp axis — parallel.long_prefill). One
+        jitted callable; jax.jit specializes per input shape."""
+        if self._sp_prefill_jit is None:
+            cfg = self.cfg
+            mesh = self.mesh
+
+            def sp_prefill(params, padded, true_len, cache):
+                from fei_tpu.parallel.long_prefill import prefill_ring_kv
+
+                logits, k_all, v_all = prefill_ring_kv(
+                    params, cfg, padded, mesh, true_len=true_len
+                )
+                k = jax.lax.dynamic_update_slice(
+                    cache.k, k_all.astype(cache.k.dtype), (0, 0, 0, 0, 0)
+                )
+                v = jax.lax.dynamic_update_slice(
+                    cache.v, v_all.astype(cache.v.dtype), (0, 0, 0, 0, 0)
+                )
+                return logits, cache._replace(k=k, v=v, length=true_len)
+
+            self._sp_prefill_jit = jax.jit(sp_prefill, donate_argnums=(3,))
+        return self._sp_prefill_jit
+
     def prefill(self, prompt_ids: Sequence[Sequence[int]], cache: KVCache):
         """Pad prompts to a bucket, run one forward, fix cache lengths.
-        Returns (last_valid_logits [B, V] float32, cache)."""
+        Returns (last_valid_logits [B, V] float32, cache).
+
+        Long prompts (>= ``long_prefill_min``) on a mesh with an sp axis
+        run SEQUENCE-SHARDED: the full model forward over ring attention
+        (parallel.long_prefill), each device holding T/n tokens — this is
+        the engine behavior serving the agent loop's unbounded contexts,
+        not just a library. The produced cache is identical in contract.
+        """
         B = len(prompt_ids)
         lengths = [len(p) for p in prompt_ids]
         max_len = max(lengths)
@@ -539,12 +600,18 @@ class InferenceEngine:
                 f"prompt length {max_len} exceeds engine max_seq_len {self.max_seq_len}"
             )
         bucket = min(_next_bucket(max_len), self.max_seq_len)
+        true_len = jnp.array(lengths, dtype=jnp.int32)
         padded = jnp.array(
             [list(p) + [0] * (bucket - n) for p, n in zip(prompt_ids, lengths)],
             dtype=jnp.int32,
         )
+        if self._sp_prefill_eligible(max_len) and cache.k.shape[2] >= bucket:
+            METRICS.incr("engine.sp_prefills")
+            with METRICS.span("prefill_sp", jax_trace=True):
+                return self._sp_prefill_fn()(
+                    self.params, padded, true_len, cache
+                )
         logits, cache = self._prefill_fn(bucket)(self.params, padded, cache)
-        true_len = jnp.array(lengths, dtype=jnp.int32)
         # padding wrote garbage kv beyond each true length; resetting length
         # masks it out of attention and decode overwrites it slot by slot
         cache = cache._replace(length=true_len)

@@ -118,6 +118,13 @@ class PagedScheduler:
         import os as _os
 
         self.prefill_chunk = int(_os.environ.get("FEI_TPU_PREFILL_CHUNK", "256"))
+        # sp admission cap: one sequence-sharded dispatch may cover at most
+        # this many prefill_chunks PER DEVICE before the bounded-stall
+        # chunked path takes over (the sp dispatch blocks live decode for
+        # its whole duration)
+        self.sp_admit_factor = int(
+            _os.environ.get("FEI_TPU_SP_ADMIT_FACTOR", "8")
+        )
         self._admitting: dict | None = None  # in-flight chunked admission
         self._prefix = None  # PrefixCache when engine.prefix_cache
         self._gather_jit: dict = {}
@@ -378,7 +385,28 @@ class PagedScheduler:
                     alloc.share(slot, prefix)
                     alloc.drop_ref(prefix)  # pin handed over to the seq ref
             try:
-                if prefix or len(seq.prompt_ids) > self.prefill_chunk:
+                # long prompts on an sp mesh admit SEQUENCE-SHARDED in one
+                # dispatch (ring-attention full-model prefill via
+                # engine.prefill's routing) — n× fewer dispatches than
+                # serial chunks. The single dispatch DOES stall live decode
+                # for its duration, so it is capped: beyond
+                # sp_admit_factor × prefill_chunk tokens PER DEVICE the
+                # chunked path keeps its bounded-stall guarantee. Prefix-
+                # cache hits also keep the chunked path: its page gather
+                # already skips recomputing the cached tokens.
+                n_tok = len(seq.prompt_ids)
+                sp_n = (
+                    self.engine.mesh.shape.get("sp", 1)
+                    if self.engine.mesh is not None else 1
+                )
+                sp_long = (
+                    not prefix
+                    and self.engine._sp_prefill_eligible(n_tok)
+                    and n_tok <= self.sp_admit_factor * self.prefill_chunk * sp_n
+                )
+                if (
+                    prefix or len(seq.prompt_ids) > self.prefill_chunk
+                ) and not sp_long:
                     self._start_chunked(seq, slot, prefix)
                     return  # one chunked admission at a time
                 self._admit(seq, slot)

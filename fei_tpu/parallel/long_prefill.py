@@ -86,20 +86,22 @@ def _prefill_shard(
     return x, ks, vs
 
 
-def prefill_ring(
+def prefill_ring_kv(
     params: dict,
     cfg: ModelConfig,
     tokens: jnp.ndarray,  # [B, T], T divisible by the sp axis size
     mesh: Mesh,
-    max_seq_len: int | None = None,
     axis_name: str = "sp",
     attend: str = "ring",
-) -> tuple[jnp.ndarray, KVCache]:
-    """Sequence-parallel prefill. Returns (last-token logits [B, V] fp32,
-    dense KVCache with length = T, sized ``max_seq_len`` or T).
-    ``attend="ulysses"`` swaps ring rotation for the head↔seq all_to_all
-    formulation (SURVEY §2.4 Ulysses row) — same contract, different
-    ICI traffic pattern (better when T/n >> H/n·D)."""
+    true_len: jnp.ndarray | None = None,  # [B] int32 valid prompt lengths
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Core sequence-parallel prefill: (last-valid logits [B, V] fp32,
+    k_all, v_all [L, B, T, K, D] sequence-sharded). ``true_len`` supports
+    bucket-padded prompts (the engine pads to a power-of-two bucket): the
+    logits come from position ``true_len - 1`` and K/V beyond it is garbage
+    the caller masks via the cache length — the same invariant as dense
+    prefill padding. Causality keeps trailing pad tokens from perturbing
+    real positions."""
     B, T = tokens.shape
     n = mesh.shape[axis_name]
     if attend not in ("ring", "ulysses"):
@@ -130,10 +132,38 @@ def prefill_ring(
     )
     x, k_all, v_all = fn(x, params["layers"], cos, sin)
 
-    # last-token logits (the full x is only needed for its final position)
-    last = x[:, -1, :]
+    # last-valid-token logits (the full x is only needed for one position)
+    if true_len is None:
+        last = x[:, -1, :]
+    else:
+        idx = (true_len - 1).astype(jnp.int32)[:, None, None]
+        last = jnp.take_along_axis(
+            x, jnp.broadcast_to(idx, (B, 1, x.shape[-1])), axis=1
+        )[:, 0, :]
     last = rms_norm(last, params["final_norm"], cfg.rms_norm_eps)
     logits = _logits(last, params, cfg)
+    return logits, k_all, v_all
+
+
+def prefill_ring(
+    params: dict,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,  # [B, T], T divisible by the sp axis size
+    mesh: Mesh,
+    max_seq_len: int | None = None,
+    axis_name: str = "sp",
+    attend: str = "ring",
+) -> tuple[jnp.ndarray, KVCache]:
+    """Sequence-parallel prefill. Returns (last-token logits [B, V] fp32,
+    dense KVCache with length = T, sized ``max_seq_len`` or T).
+    ``attend="ulysses"`` swaps ring rotation for the head↔seq all_to_all
+    formulation (SURVEY §2.4 Ulysses row) — same contract, different
+    ICI traffic pattern (better when T/n >> H/n·D)."""
+    B, T = tokens.shape
+    logits, k_all, v_all = prefill_ring_kv(
+        params, cfg, tokens, mesh, axis_name=axis_name, attend=attend
+    )
+    dtype = params["embed"].dtype
 
     S = max_seq_len or T
     if S < T:

@@ -1,0 +1,119 @@
+"""Long-context prefill as ENGINE behavior (VERDICT round-2 item 5).
+
+parallel/long_prefill.py existed as a verified library; these tests pin the
+wiring: prompts >= ``long_prefill_min`` on a mesh with an sp axis prefill
+sequence-sharded (ring-attention full-model) through the PUBLIC engine
+paths — ``InferenceEngine.prefill`` for the dense path, and scheduler
+admission for the paged serving path, where the one-dispatch sp prefill
+replaces the serial chunk sequence and live decode streams keep flowing.
+Reference workload: the unbounded agent task loop
+(/root/reference/fei/core/task_executor.py:231-252).
+"""
+
+from __future__ import annotations
+
+import threading
+
+import jax
+import pytest
+
+from fei_tpu.engine.engine import GenerationConfig, InferenceEngine
+from fei_tpu.parallel.mesh import make_mesh
+from fei_tpu.utils.metrics import METRICS
+
+
+def _sp_prefills() -> float:
+    return METRICS.snapshot()["counters"].get("engine.sp_prefills", 0)
+
+
+def _mesh():
+    n = 8 if len(jax.devices()) >= 8 else len(jax.devices())
+    return make_mesh({"sp": n}, devices=jax.devices()[:n])
+
+
+PROMPT = [(17 * i + 3) % 200 + 10 for i in range(1024)]
+
+
+class TestEngineSpPrefill:
+    def test_long_prompt_routes_sequence_sharded_and_matches_dense(self):
+        gen = GenerationConfig(max_new_tokens=8, ignore_eos=True)
+        dense = InferenceEngine.from_config("tiny", max_seq_len=2048)
+        want = dense.generate(PROMPT, gen).token_ids
+
+        sp = InferenceEngine.from_config(
+            "tiny", max_seq_len=2048, mesh=_mesh(), long_prefill_min=512
+        )
+        before = _sp_prefills()
+        got = sp.generate(PROMPT, gen).token_ids
+        assert _sp_prefills() > before, "sp prefill did not run"
+        assert got == want, (got, want)
+
+    def test_short_prompt_stays_on_dense_prefill(self):
+        sp = InferenceEngine.from_config(
+            "tiny", max_seq_len=2048, mesh=_mesh(), long_prefill_min=512
+        )
+        gen = GenerationConfig(max_new_tokens=4, ignore_eos=True)
+        before = _sp_prefills()
+        sp.generate(list(range(20, 60)), gen)
+        assert _sp_prefills() == before
+
+
+class TestSchedulerSpAdmission:
+    def test_sp_admission_matches_chunked_and_interleaves_decode(self):
+        gen_long = GenerationConfig(max_new_tokens=12, ignore_eos=True)
+        gen_live = GenerationConfig(max_new_tokens=48, ignore_eos=True)
+
+        # reference: SAME serving stack, sp disabled (threshold above the
+        # prompt) -> serial chunked admission
+        chunked = InferenceEngine.from_config(
+            "tiny", paged=True, batch_size=2, max_seq_len=2048,
+            long_prefill_min=1 << 30,
+        )
+        want_long = list(chunked.scheduler.stream(PROMPT, gen_long))
+        want_live = list(
+            chunked.scheduler.stream(list(range(40, 72)), gen_live)
+        )
+
+        sp = InferenceEngine.from_config(
+            "tiny", paged=True, batch_size=2, max_seq_len=2048,
+            mesh=_mesh(), long_prefill_min=512,
+        )
+        results: dict = {}
+        started = threading.Event()
+
+        def live():
+            out = []
+            for i, tok in enumerate(
+                sp.scheduler.stream(list(range(40, 72)), gen_live)
+            ):
+                out.append(tok)
+                if i == 4:
+                    started.set()  # live decode underway; admit the long one
+            results["live"] = out
+
+        def long_prompt():
+            started.wait(timeout=60)
+            results["long"] = list(sp.scheduler.stream(PROMPT, gen_long))
+
+        before = _sp_prefills()
+        ts = [threading.Thread(target=live), threading.Thread(target=long_prompt)]
+        [t.start() for t in ts]
+        [t.join(timeout=600) for t in ts]
+        assert _sp_prefills() > before, "scheduler admission did not use sp"
+        # the live stream decoded to completion across the long admission
+        assert results["live"] == want_live
+        # and the sp-admitted stream is token-identical to chunked admission
+        assert results["long"] == want_long
+
+    def test_prefix_cache_hit_keeps_chunked_path(self):
+        sp = InferenceEngine.from_config(
+            "tiny", paged=True, batch_size=2, max_seq_len=2048,
+            mesh=_mesh(), long_prefill_min=512, prefix_cache=True,
+        )
+        gen = GenerationConfig(max_new_tokens=6, ignore_eos=True)
+        first = list(sp.scheduler.stream(PROMPT, gen))  # sp admission
+        before = _sp_prefills()
+        second = list(sp.scheduler.stream(PROMPT, gen))  # prefix hit
+        # the rerun reused cached pages (chunked/gather path), not sp
+        assert _sp_prefills() == before
+        assert second == first
