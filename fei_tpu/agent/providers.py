@@ -326,7 +326,10 @@ class JaxLocalProvider(Provider):
         emitted = 0
         grammar = self._tool_grammar(tools)
         # greedy agent turns use prompt-lookup speculation (token-identical
-        # to plain greedy; multi-token steps whenever output echoes context)
+        # to plain greedy; multi-token steps whenever output echoes
+        # context). Paged engines speculate INSIDE the scheduler
+        # (PagedScheduler._maybe_spec_step), so the dense lookahead wrapper
+        # is only selected for the non-paged path.
         speculate = (
             gen.temperature == 0.0
             and not self.engine.paged

@@ -125,6 +125,12 @@ class PagedScheduler:
         self.sp_admit_factor = int(
             _os.environ.get("FEI_TPU_SP_ADMIT_FACTOR", "8")
         )
+        # prompt-lookup speculation for the single-stream paged case (the
+        # agent serving shape): greedy echoes of prompt content verify in
+        # one multi-token dispatch. FEI_TPU_SPECULATE=0 disables.
+        self.spec_ngram = int(_os.environ.get("FEI_TPU_SPEC_NGRAM", "3"))
+        self.spec_draft_len = int(_os.environ.get("FEI_TPU_SPEC_DRAFT", "8"))
+        self.speculate = _os.environ.get("FEI_TPU_SPECULATE", "1") != "0"
         self._admitting: dict | None = None  # in-flight chunked admission
         self._prefix = None  # PrefixCache when engine.prefix_cache
         self._gather_jit: dict = {}
@@ -706,9 +712,96 @@ class PagedScheduler:
         if len(seq.generated) >= seq.budget:
             self._finish(seq)
 
+    def _maybe_spec_step(self) -> bool:
+        """Prompt-lookup speculation inside the scheduler: when exactly one
+        greedy, unconstrained stream is decoding (the dominant agent-loop
+        serving shape), a repeated n-gram proposes draft tokens and ONE
+        multi-token paged dispatch (forward_paged_block) verifies them —
+        token-identical to the per-step path by construction, with up to
+        1 + draft_len tokens landing per weight read. Multi-stream batches
+        keep per-token steps (their throughput already amortizes the
+        weight read across slots). Returns True if a spec step ran."""
+        if not self.speculate:
+            return False
+        if self._admitting is not None:
+            return False
+        active = [
+            (b, s) for b, s in enumerate(self._slots) if s is not None
+        ]
+        if len(active) != 1:
+            return False
+        b, s = active[0]
+        if (
+            s.prefilling
+            or s.gen.temperature != 0.0
+            or s.mask_fn is not None
+            or s.grammar is not None
+        ):
+            return False
+        eng = self.engine
+        draft = eng._find_draft(
+            s.prompt_ids + s.generated, self.spec_ngram, self.spec_draft_len
+        )
+        if draft is None:
+            return False
+        T = 1 + self.spec_draft_len
+        # pool length for the slot: prompt + generated, minus the pending
+        # next_input whose KV is written when it is fed
+        L0 = len(s.prompt_ids) + len(s.generated) - 1
+        room = len(eng._allocator.pages_for(b)) * eng.page_size
+        if L0 + T > min(room, eng.max_seq_len):
+            return False
+        draft = draft + [0] * (self.spec_draft_len - len(draft))
+        tokens = np.zeros((self.B, T), dtype=np.int32)
+        tokens[b] = [s.next_input] + draft
+        with METRICS.span("spec_step"):
+            greedy_dev, self._pool = self._spec_fn(T)(
+                eng.params, self._pool, jnp.asarray(tokens)
+            )
+            greedy = np.asarray(greedy_dev)[b]  # host sync inside the span
+        accept = 0
+        while (
+            accept < self.spec_draft_len
+            and draft[accept] == int(greedy[accept])
+        ):
+            accept += 1
+        # greedy[:accept + 1] are all model-chosen tokens (verified draft
+        # prefix + the bonus token). KV is real through L0 + accept; the
+        # block wrote T rows, so shrink the slot's length — inactive slots'
+        # lengths return to 0 (their writes landed in the null page)
+        lengths = np.zeros((self.B,), dtype=np.int32)
+        lengths[b] = L0 + accept + 1
+        self._pool = self._pool._replace(lengths=jnp.asarray(lengths))
+        METRICS.incr("scheduler.spec_steps")
+        METRICS.incr("scheduler.spec_accepted", accept)
+        for t in [int(g) for g in greedy[: accept + 1]]:
+            self._deliver(s, t)
+            if s.finished:
+                break
+        return True
+
+    def _spec_fn(self, T: int):
+        key = ("spec", T)
+        if key not in self._step_jit:
+            cfg = self.engine.cfg
+            mesh = self.engine.mesh
+
+            def spec(params, pool, tokens):
+                from fei_tpu.models.llama import forward_paged_block
+
+                logits, pool = forward_paged_block(
+                    params, cfg, tokens, pool, kernel_mesh=mesh
+                )
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32), pool
+
+            self._step_jit[key] = jax.jit(spec, donate_argnums=(1,))
+        return self._step_jit[key]
+
     def _step_active(self) -> None:
         eng = self.engine
         B, V = self.B, eng.cfg.vocab_size
+        if self._maybe_spec_step():
+            return
         # evaluate per-request masks FIRST: a user mask_fn that raises (or
         # returns an over-wide mask) must kill only its own request, never
         # the other in-flight sequences or the pool

@@ -285,56 +285,89 @@ def forward_paged(
     under shard_map with kv heads sharded (XLA cannot auto-partition a
     pallas_call), making multi-chip paged serving real; everything else in
     the layer partitions from the param/pool shardings as usual.
+
+    Implemented as the T=1 case of ``forward_paged_block`` so single-step
+    decode and speculative verification can never diverge.
+    """
+    return forward_paged_block(
+        params, cfg, tokens, cache,
+        routed_moe=routed_moe, moe_mesh=moe_mesh, kernel_mesh=kernel_mesh,
+    )
+
+
+def forward_paged_block(
+    params: dict,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,  # [B, T] int32 — T draft tokens per sequence
+    cache,  # PagedKVCache
+    routed_moe: bool = False,
+    moe_mesh=None,
+    kernel_mesh=None,
+) -> tuple[jnp.ndarray, object]:
+    """Multi-token paged forward for speculative VERIFICATION.
+
+    All T tokens' projections/MLP batch into single matmuls (one weight
+    read for T tokens — the point of speculation on a weight-streaming-
+    bound decode), their K/V scatter into the sequence's pool pages, and
+    each position attends pool history + the block prefix via T unrolled
+    invocations of the single-query ragged kernel. T is small (1 +
+    draft_len); a true multi-query paged kernel would read history once
+    instead of T times and is the natural next optimization. Returns
+    (logits [B, T, V] fp32, cache with lengths += T). The CALLER owns
+    rollback: only the accepted prefix's K/V is real — shrink ``lengths``
+    to mask the rest, exactly like the dense lookahead path.
     """
     from fei_tpu.engine.paged_cache import write_token_kv
     from fei_tpu.ops.pallas import paged_attention
     from fei_tpu.ops.pallas.paged_attention import paged_attention_sharded
 
-    B = tokens.shape[0]
+    B, T = tokens.shape
     K, d, Hq = cfg.num_kv_heads, cfg.head_dim_, cfg.num_heads
-    positions = cache.lengths[:, None]  # [B, 1]
+    positions = cache.lengths[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
     max_pos = cache.block_table.shape[1] * cache.page_size
     cos, sin = compute_rope_freqs(cfg.head_dim_, max_pos, cfg.rope_theta)
 
-    # activations follow the pool dtype for bf16/fp32 pools; int8 pools are
-    # storage-only — compute stays in the embedding dtype
     kv_int8 = cache.k_scales is not None
     dtype = params["embed"].dtype if kv_int8 else cache.k_pages.dtype
-    x = params["embed"][tokens].astype(dtype)  # [B, 1, h]
+    x = params["embed"][tokens].astype(dtype)  # [B, T, h]
 
     def body(x, layer_inputs):
-        # kp/vp: [P, K, ps, D] this layer's pool (+ scale pools when int8)
         if kv_int8:
             lp, kp, vp, ksc, vsc = layer_inputs
         else:
             lp, kp, vp = layer_inputs
             ksc = vsc = None
         y = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
-        q = mm(y, lp["wq"]).reshape(B, 1, Hq, d)
-        k = mm(y, lp["wk"]).reshape(B, 1, K, d)
-        v = mm(y, lp["wv"]).reshape(B, 1, K, d)
+        q = mm(y, lp["wq"]).reshape(B, T, Hq, d)
+        k = mm(y, lp["wk"]).reshape(B, T, K, d)
+        v = mm(y, lp["wv"]).reshape(B, T, K, d)
         q = apply_rope(q, cos, sin, positions)
         k = apply_rope(k, cos, sin, positions)
 
-        written = write_token_kv(
-            kp, vp, k[:, 0], v[:, 0], cache.block_table, cache.lengths,
-            k_scales=ksc, v_scales=vsc,
-        )
-        if kv_int8:
-            kp, vp, ksc, vsc = written
-        else:
-            kp, vp = written
-        if kernel_mesh is not None and kernel_mesh.shape.get("tp", 1) > 1:
-            attn = paged_attention_sharded(
-                q[:, 0], kp, vp, cache.block_table, cache.lengths + 1,
-                kernel_mesh, axis_name="tp", k_scales=ksc, v_scales=vsc,
+        attns = []
+        for i in range(T):  # static unroll — page writes then attention
+            written = write_token_kv(
+                kp, vp, k[:, i], v[:, i], cache.block_table,
+                cache.lengths + i, k_scales=ksc, v_scales=vsc,
             )
-        else:
-            attn = paged_attention(
-                q[:, 0], kp, vp, cache.block_table, cache.lengths + 1,
-                k_scales=ksc, v_scales=vsc,
-            )  # [B, Hq, D]
-        x = x + mm(attn.reshape(B, 1, Hq * d), lp["wo"])
+            if kv_int8:
+                kp, vp, ksc, vsc = written
+            else:
+                kp, vp = written
+            if kernel_mesh is not None and kernel_mesh.shape.get("tp", 1) > 1:
+                a = paged_attention_sharded(
+                    q[:, i], kp, vp, cache.block_table,
+                    cache.lengths + i + 1, kernel_mesh, axis_name="tp",
+                    k_scales=ksc, v_scales=vsc,
+                )
+            else:
+                a = paged_attention(
+                    q[:, i], kp, vp, cache.block_table,
+                    cache.lengths + i + 1, k_scales=ksc, v_scales=vsc,
+                )  # [B, Hq, D]
+            attns.append(a)
+        attn = jnp.stack(attns, axis=1)  # [B, T, Hq, D]
+        x = x + mm(attn.reshape(B, T, Hq * d), lp["wo"])
 
         y = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
         if cfg.is_moe:
@@ -359,7 +392,7 @@ def forward_paged(
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     logits = _logits(x, params, cfg)
     new_cache = cache._replace(
-        k_pages=new_k, v_pages=new_v, lengths=cache.lengths + 1,
+        k_pages=new_k, v_pages=new_v, lengths=cache.lengths + T,
         k_scales=new_ks, v_scales=new_vs,
     )
     return logits, new_cache

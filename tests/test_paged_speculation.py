@@ -1,0 +1,138 @@
+"""Prompt-lookup speculation inside the paged scheduler (VERDICT round-2
+weakness #5: speculation and paged serving were mutually exclusive).
+
+The single-stream paged case — the agent task loop's dominant serving
+shape — now takes multi-token verified steps via one forward_paged_block
+dispatch when the greedy output echoes earlier context. Output must be
+token-identical to the per-step scheduler path by construction.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from fei_tpu.engine.engine import GenerationConfig, InferenceEngine
+from fei_tpu.utils.metrics import METRICS
+
+
+def _counter(name: str) -> float:
+    return METRICS.snapshot()["counters"].get(name, 0)
+
+
+def _engine(**kw):
+    return InferenceEngine.from_config(
+        "tiny", paged=True, batch_size=2, max_seq_len=512, **kw
+    )
+
+
+REPETITIVE = None  # set lazily from tokenizer
+
+
+def _prompt(eng):
+    return eng.tokenizer.encode(
+        "def foo(a, b): return a + b\ndef foo(a, b): return a + b\n",
+        add_bos=True,
+    )
+
+
+class TestPagedSpeculation:
+    def test_single_stream_matches_unspeculated(self, monkeypatch):
+        gen = GenerationConfig(max_new_tokens=24, temperature=0.0,
+                               ignore_eos=True)
+        ref_eng = _engine()
+        monkeypatch.setenv("FEI_TPU_SPECULATE", "0")
+        want = list(ref_eng.scheduler.stream(_prompt(ref_eng), gen))
+        monkeypatch.setenv("FEI_TPU_SPECULATE", "1")
+        eng = _engine()
+        got = list(eng.scheduler.stream(_prompt(eng), gen))
+        assert got == want
+
+    def test_spec_step_runs_and_takes_multi_token_steps(self, monkeypatch):
+        """Force drafts (even bogus ones): verification must reject wrong
+        tokens and still emit the exact greedy stream, with fewer
+        dispatches than tokens whenever a draft lands."""
+        gen = GenerationConfig(max_new_tokens=20, temperature=0.0,
+                               ignore_eos=True)
+        ref_eng = _engine()
+        monkeypatch.setenv("FEI_TPU_SPECULATE", "0")
+        want = list(ref_eng.scheduler.stream(_prompt(ref_eng), gen))
+
+        monkeypatch.setenv("FEI_TPU_SPECULATE", "1")
+        eng = _engine()
+        drafts = iter(range(1000))
+
+        def fake_draft(ids, ngram, draft_len):
+            k = (next(drafts) % draft_len) + 1
+            # every other proposal starts with the true echo continuation
+            return [(ids[-1] + i) % 256 for i in range(k)]
+
+        monkeypatch.setattr(
+            type(eng), "_find_draft", staticmethod(fake_draft)
+        )
+        before = _counter("scheduler.spec_steps")
+        got = list(eng.scheduler.stream(_prompt(eng), gen))
+        assert got == want
+        assert _counter("scheduler.spec_steps") > before
+
+    def test_multi_token_acceptance_on_echoing_output(self, monkeypatch):
+        """With the model's own continuation offered as the draft, every
+        token is accepted: tokens-per-dispatch must exceed 1."""
+        gen = GenerationConfig(max_new_tokens=24, temperature=0.0,
+                               ignore_eos=True)
+        monkeypatch.setenv("FEI_TPU_SPECULATE", "0")
+        ref_eng = _engine()
+        want = list(ref_eng.scheduler.stream(_prompt(ref_eng), gen))
+
+        monkeypatch.setenv("FEI_TPU_SPECULATE", "1")
+        eng = _engine()
+        n_prompt = len(_prompt(eng))
+
+        def oracle_draft(ids, ngram, draft_len):
+            done = len(ids) - n_prompt
+            nxt = want[done:done + draft_len]
+            return list(nxt) or None
+
+        monkeypatch.setattr(
+            type(eng), "_find_draft", staticmethod(oracle_draft)
+        )
+        s0, a0 = _counter("scheduler.spec_steps"), _counter(
+            "scheduler.spec_accepted"
+        )
+        got = list(eng.scheduler.stream(_prompt(eng), gen))
+        steps = _counter("scheduler.spec_steps") - s0
+        accepted = _counter("scheduler.spec_accepted") - a0
+        assert got == want
+        assert steps > 0 and accepted > 0
+        # oracle drafts: nearly every dispatch lands multiple tokens
+        assert (accepted + steps) / steps > 2.0, (accepted, steps)
+
+    def test_two_streams_disable_spec_but_stay_exact(self, monkeypatch):
+        gen = GenerationConfig(max_new_tokens=16, temperature=0.0,
+                               ignore_eos=True)
+        monkeypatch.setenv("FEI_TPU_SPECULATE", "0")
+        ref_eng = _engine()
+        want = list(ref_eng.scheduler.stream(_prompt(ref_eng), gen))
+
+        monkeypatch.setenv("FEI_TPU_SPECULATE", "1")
+        eng = _engine()
+        results: dict = {}
+
+        def run(tag):
+            results[tag] = list(eng.scheduler.stream(_prompt(eng), gen))
+
+        ts = [threading.Thread(target=run, args=(i,)) for i in range(2)]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        assert results[0] == want and results[1] == want
+
+    def test_sampled_stream_never_speculates(self, monkeypatch):
+        monkeypatch.setenv("FEI_TPU_SPECULATE", "1")
+        eng = _engine()
+        gen = GenerationConfig(max_new_tokens=8, temperature=0.9, seed=7,
+                               ignore_eos=True)
+        before = _counter("scheduler.spec_steps")
+        toks = list(eng.scheduler.stream(_prompt(eng), gen))
+        assert len(toks) == 8
+        assert _counter("scheduler.spec_steps") == before
