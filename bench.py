@@ -15,6 +15,13 @@ Suites (FEI_TPU_BENCH_SUITE):
                      task-loop serving shape)
   moe              — routed-MoE decode on the bench-scale Mixtral-shaped
                      config (BASELINE config #4 on one chip)
+  agent            — end-to-end `fei --message` through the whole stack
+  remote           — BASELINE config #1: client-path floor via
+                     RemoteProvider against a loopback OpenAI-compatible
+                     stub (no device involved)
+  federation       — BASELINE config #5 shape: 4-node shared-embedding
+                     all-gather bandwidth + propose->consensus p50 on the
+                     hermetic 4-device CPU mesh
 
 Knobs:
   FEI_TPU_BENCH_MODEL    (decode default llama3-8b — the BASELINE config #2
@@ -361,6 +368,158 @@ def bench_moe(model: str, n_tokens: int) -> int:
     return bench_decode(model, n_tokens)
 
 
+def bench_remote(n_tokens: int) -> int:
+    """BASELINE config #1: the remote-client transport baseline — the full
+    `fei --message` stack (Assistant → RemoteProvider → HTTP) against a
+    loopback OpenAI-compatible stub. No TPU involved by design: the number
+    is the CLIENT-PATH floor the in-tree jax_local provider replaces
+    (reference transport: fei/core/assistant.py:524-530)."""
+    import asyncio
+    import http.server
+    import threading
+
+    from fei_tpu.agent import Assistant
+    from fei_tpu.agent.providers import RemoteProvider
+
+    content = " ".join(f"tok{i}" for i in range(n_tokens))
+    body = json.dumps({
+        "choices": [{
+            "message": {"role": "assistant", "content": content},
+            "finish_reason": "stop",
+        }],
+        "usage": {"prompt_tokens": 64, "completion_tokens": n_tokens,
+                  "total_tokens": 64 + n_tokens},
+    }).encode()
+
+    class Stub(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):
+            self.rfile.read(int(self.headers.get("Content-Length", 0)))
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):  # noqa: D102 — silence request spam
+            pass
+
+    server = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Stub)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{server.server_address[1]}/v1"
+    provider = RemoteProvider("openai", model="stub", api_key="local",
+                              api_base=base)
+    message = "Summarize what a Maildir filename encodes."
+
+    def turn() -> float:
+        assistant = Assistant(provider=provider, max_tokens=n_tokens)
+        t0 = time.perf_counter()
+        asyncio.run(assistant.chat(message))
+        return time.perf_counter() - t0
+
+    turn()  # warm-up (event loop, connection setup)
+    lats = [turn() for _ in range(20)]
+    server.shutdown()
+    p50 = sorted(lats)[len(lats) // 2]
+    tok_s = n_tokens * len(lats) / sum(lats)
+    log(f"bench: remote client loopback: p50 turn {p50*1000:.1f} ms, "
+        f"{tok_s:.0f} tok/s through the full client path "
+        f"({len(lats)} turns, {n_tokens} tok canned completion)")
+    return _emit("remote_client_loopback_e2e_tok_s", tok_s)
+
+
+def bench_federation(n_tokens: int) -> int:
+    """BASELINE config #5 shape on the hermetic mesh: 4 federation nodes —
+    (a) shared-embedding bank all-gather over the mesh's node axis (the ICI
+    data plane that replaces the reference's HTTP JSON gossip,
+    memdir_tools/memorychain.py:1003-1035) and (b) propose→consensus→commit
+    latency over the loopback transport (51 % quorum)."""
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from fei_tpu.memory.memorychain.chain import MemoryChain
+    from fei_tpu.memory.memorychain.embedding_exchange import (
+        EmbeddingFederation,
+        exchange_banks,
+    )
+    from fei_tpu.memory.memorychain.transport import LoopbackTransport
+    from fei_tpu.parallel.mesh import make_mesh
+
+    n_nodes = 4
+    devs = jax.devices()
+    if len(devs) < n_nodes:
+        log(f"bench: federation needs {n_nodes} devices, have {len(devs)}")
+        return 1
+    mesh = make_mesh({"dp": n_nodes}, devices=devs[:n_nodes])
+    bank, dim = int(os.environ.get("FEI_TPU_BENCH_FED_BANK", "4096")), 256
+    feds = [
+        EmbeddingFederation(i, n_nodes, bank_size=bank, dim=dim)
+        for i in range(n_nodes)
+    ]
+    for i, fed in enumerate(feds):
+        for j in range(64):
+            fed.add(f"mem-{i}-{j}", f"node {i} memory {j} maildir flags tools")
+    banks = np.stack([f.local_bank for f in feds])  # [4, bank, 256] fp32
+
+    # the bank lives ON DEVICE in a real node (its compute produces it);
+    # land it sharded once so the loop times the collective, not a
+    # host->device upload per iteration
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    dev_banks = jax.device_put(
+        jnp.asarray(banks), NamedSharding(mesh, P("dp"))
+    )
+    # jit once so the loop times the collective, not per-call shard_map
+    # re-lowering; block every iteration (queueing unbounded CPU
+    # collectives can abort) without transferring the 4x-redundant view —
+    # this suite always runs on the forced CPU mesh, where
+    # block_until_ready is real (the axon caveat doesn't apply)
+    import functools
+
+    gather = jax.jit(functools.partial(exchange_banks, mesh=mesh))
+    jax.block_until_ready(gather(dev_banks))  # compile
+    iters = 20
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        jax.block_until_ready(gather(dev_banks))
+    dt = time.perf_counter() - t0
+    recv = banks.nbytes * (n_nodes - 1) / n_nodes  # bytes received/device
+    gbps = iters * recv / dt / 1e9
+    log(f"bench: federation all-gather: {banks.nbytes/1e6:.1f} MB bank, "
+        f"{gbps:.2f} GB/s effective per device over {iters} iters")
+
+    # the gathered view must actually serve recall
+    feds[0].sync(mesh, banks)
+    hits = feds[0].search("maildir flags", top_k=3)
+    assert hits, "federation search returned nothing"
+
+    tmp = tempfile.mkdtemp(prefix="fei-fed-bench-")
+    lb = LoopbackTransport()
+    chains = [
+        MemoryChain(node_id=f"bench-n{i}", base_dir=tmp, transport=lb)
+        for i in range(n_nodes)
+    ]
+    for i, c in enumerate(chains):
+        lb.register(f"n{i}", c)
+        c.peers = [f"n{j}" for j in range(n_nodes) if j != i]
+    lats = []
+    for k in range(20):
+        t1 = time.perf_counter()
+        blk = chains[0].propose_memory(
+            {"content": f"bench memory {k}",
+             "headers": {"Subject": f"bench {k}"}}
+        )
+        lats.append(time.perf_counter() - t1)
+        if blk is None:
+            raise RuntimeError("federation proposal rejected")
+    p50 = sorted(lats)[len(lats) // 2]
+    log(f"bench: federation consensus: propose->commit p50 "
+        f"{p50*1000:.2f} ms (4 nodes, 51% quorum, loopback transport)")
+    return _emit("federation_4node_embed_allgather_GBps", gbps, unit="GB/s")
+
+
 def bench_agent(model: str, n_tokens: int) -> int:
     """End-to-end `fei --message` shape (BASELINE config #3): chat template
     -> jax_local provider -> engine stream -> incremental detokenize ->
@@ -423,6 +582,17 @@ def bench_agent(model: str, n_tokens: int) -> int:
 
 def main() -> int:
     suite = os.environ.get("FEI_TPU_BENCH_SUITE", "decode")
+    if suite == "federation" and os.environ.get("FEI_TPU_FED_READY") != "1":
+        # the federation suite needs a multi-device mesh: re-exec onto the
+        # 4-device virtual CPU mesh BEFORE jax initializes any backend
+        os.environ["FEI_TPU_FED_READY"] = "1"
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=4"
+            ).strip()
+        os.execv(sys.executable, [sys.executable] + sys.argv)
     if suite == "moe":
         default_model = "moe-2b"
     elif suite == "decode":
@@ -440,12 +610,17 @@ def main() -> int:
     ):
         os.environ["FEI_TPU_BENCH_QUANT"] = "int8"
     n_tokens = int(os.environ.get("FEI_TPU_BENCH_TOKENS", "256"))
+    if suite == "remote":
+        # client-path baseline: no device backend involved at all
+        return bench_remote(min(n_tokens, 256))
     if os.environ.get("JAX_PLATFORMS"):
         # the container's sitecustomize pins the axon TPU platform and
         # ignores the env var; honor it explicitly so CPU smoke runs work
         import jax
 
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    if suite == "federation":
+        return bench_federation(n_tokens)
     backend, devices = _touch_backend_or_reexec()
     if os.environ.get("FEI_TPU_BENCH_CPU_FALLBACK"):
         model = os.environ["FEI_TPU_BENCH_MODEL"]  # shrunk to 'tiny'

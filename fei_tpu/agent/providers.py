@@ -394,27 +394,47 @@ class MockProvider(Provider):
 
 
 class RemoteProvider(Provider):
-    """litellm passthrough for remote-API comparison baselines."""
+    """Remote-API passthrough for comparison baselines (BASELINE config #1).
+
+    Dispatches through litellm when installed (multi-provider, reference-
+    equivalent: fei/core/assistant.py:524-530). Without litellm, an
+    ``api_base`` pointing at any OpenAI-compatible ``/chat/completions``
+    endpoint is served by a dependency-free urllib client — covering local
+    deployments and the loopback client-path benchmark."""
 
     name = "remote"
 
     def __init__(self, provider: str = "anthropic", model: str | None = None,
-                 api_key: str | None = None):
+                 api_key: str | None = None, api_base: str | None = None):
+        cfg = get_config()
+        self.api_base = (
+            api_base
+            or os.environ.get(f"{provider.upper()}_API_BASE")
+            or cfg.get(provider, "api_base", None)
+        )
         try:
             import litellm  # noqa: F401
-        except ImportError as exc:  # pragma: no cover - env without litellm
-            raise ProviderError(
-                "litellm is not installed; RemoteProvider is unavailable "
-                "(the jax_local provider needs no external packages)"
-            ) from exc
+
+            self._litellm = True
+        except ImportError:
+            self._litellm = False
+            if not self.api_base:
+                raise ProviderError(
+                    "litellm is not installed and no api_base is configured; "
+                    "RemoteProvider needs one or the other (the jax_local "
+                    "provider needs no external packages)"
+                ) from None
         self.provider = provider
         self.model = model or DEFAULT_MODELS.get(provider, provider)
         self.api_key = api_key or self._resolve_key(provider)
         if not self.api_key:
-            raise AuthenticationError(
-                f"no API key for provider {provider!r}: set "
-                f"{provider.upper()}_API_KEY or LLM_API_KEY"
-            )
+            if self.api_base:
+                self.api_key = "local"  # self-hosted endpoints often keyless
+            else:
+                raise AuthenticationError(
+                    f"no API key for provider {provider!r}: set "
+                    f"{provider.upper()}_API_KEY or LLM_API_KEY"
+                )
 
     @staticmethod
     def _resolve_key(provider: str) -> str | None:
@@ -454,25 +474,78 @@ class RemoteProvider(Provider):
                 out.append({"role": role, "content": str(m.get("content", ""))})
         return out
 
-    def complete(self, messages, system=None, tools=None, max_tokens=4000):
-        import litellm
+    @staticmethod
+    def _openai_tools(tools: list[dict] | None) -> list[dict] | None:
+        if not tools:
+            return None
+        return [
+            {"type": "function",
+             "function": {"name": t["name"],
+                          "description": t.get("description", ""),
+                          "parameters": t.get("input_schema", {})}}
+            for t in tools
+        ]
 
+    def _complete_urllib(self, msgs, tools, max_tokens) -> "ProviderResponse":
+        """OpenAI-compatible /chat/completions via urllib (no litellm)."""
+        import urllib.request
+
+        payload: dict[str, Any] = {
+            "model": self.model, "messages": msgs, "max_tokens": max_tokens,
+        }
+        oa_tools = self._openai_tools(tools)
+        if oa_tools:
+            payload["tools"] = oa_tools
+        req = urllib.request.Request(
+            self.api_base.rstrip("/") + "/chat/completions",
+            data=json.dumps(payload).encode("utf-8"),
+            headers={
+                "Content-Type": "application/json",
+                "Authorization": f"Bearer {self.api_key}",
+            },
+            method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=120) as resp:
+                body = json.loads(resp.read())
+        except Exception as exc:  # noqa: BLE001
+            raise ProviderError(
+                f"remote completion failed: {exc}", cause=exc
+            ) from exc
+        msg = body["choices"][0]["message"]
+        calls = [
+            ToolCall(
+                tc.get("id", f"call_{uuid.uuid4().hex[:12]}"),
+                tc["function"]["name"],
+                json.loads(tc["function"].get("arguments") or "{}"),
+            )
+            for tc in (msg.get("tool_calls") or [])
+        ]
+        return ProviderResponse(
+            content=msg.get("content") or "",
+            tool_calls=calls,
+            stop_reason="tool_use" if calls else "stop",
+            usage=body.get("usage", {}),
+        )
+
+    def complete(self, messages, system=None, tools=None, max_tokens=4000):
         msgs = ([{"role": "system", "content": system}] if system else []) \
             + self._to_openai_messages(messages)
+        if not self._litellm:
+            return self._complete_urllib(msgs, tools, max_tokens)
+        import litellm
+
         kwargs: dict[str, Any] = {
             "model": f"{self.provider}/{self.model}",
             "messages": msgs,
             "max_tokens": max_tokens,
             "api_key": self.api_key,
         }
-        if tools:
-            kwargs["tools"] = [
-                {"type": "function",
-                 "function": {"name": t["name"],
-                              "description": t.get("description", ""),
-                              "parameters": t.get("input_schema", {})}}
-                for t in tools
-            ]
+        if self.api_base:
+            kwargs["api_base"] = self.api_base
+        oa_tools = self._openai_tools(tools)
+        if oa_tools:
+            kwargs["tools"] = oa_tools
         try:
             resp = litellm.completion(**kwargs)
         except Exception as exc:  # noqa: BLE001
