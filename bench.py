@@ -375,37 +375,15 @@ def bench_remote(n_tokens: int) -> int:
     is the CLIENT-PATH floor the in-tree jax_local provider replaces
     (reference transport: fei/core/assistant.py:524-530)."""
     import asyncio
-    import http.server
-    import threading
 
     from fei_tpu.agent import Assistant
     from fei_tpu.agent.providers import RemoteProvider
+    from fei_tpu.utils.openai_stub import serve_openai_stub
 
     content = " ".join(f"tok{i}" for i in range(n_tokens))
-    body = json.dumps({
-        "choices": [{
-            "message": {"role": "assistant", "content": content},
-            "finish_reason": "stop",
-        }],
-        "usage": {"prompt_tokens": 64, "completion_tokens": n_tokens,
-                  "total_tokens": 64 + n_tokens},
-    }).encode()
-
-    class Stub(http.server.BaseHTTPRequestHandler):
-        def do_POST(self):
-            self.rfile.read(int(self.headers.get("Content-Length", 0)))
-            self.send_response(200)
-            self.send_header("Content-Type", "application/json")
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
-
-        def log_message(self, *args):  # noqa: D102 — silence request spam
-            pass
-
-    server = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Stub)
-    threading.Thread(target=server.serve_forever, daemon=True).start()
-    base = f"http://127.0.0.1:{server.server_address[1]}/v1"
+    server, base = serve_openai_stub(
+        content=content, completion_tokens=n_tokens
+    )
     provider = RemoteProvider("openai", model="stub", api_key="local",
                               api_base=base)
     message = "Summarize what a Maildir filename encodes."
@@ -587,11 +565,19 @@ def main() -> int:
         # 4-device virtual CPU mesh BEFORE jax initializes any backend
         os.environ["FEI_TPU_FED_READY"] = "1"
         os.environ["JAX_PLATFORMS"] = "cpu"
+        import re as _re
+
         flags = os.environ.get("XLA_FLAGS", "")
-        if "xla_force_host_platform_device_count" not in flags:
-            os.environ["XLA_FLAGS"] = (
-                flags + " --xla_force_host_platform_device_count=4"
-            ).strip()
+        flag = "--xla_force_host_platform_device_count=4"
+        if "xla_force_host_platform_device_count" in flags:
+            # a pre-existing smaller count would leave the suite unable to
+            # build its 4-node mesh — override, don't trust
+            flags = _re.sub(
+                r"--xla_force_host_platform_device_count=\d+", flag, flags
+            )
+        else:
+            flags = (flags + " " + flag).strip()
+        os.environ["XLA_FLAGS"] = flags
         os.execv(sys.executable, [sys.executable] + sys.argv)
     if suite == "moe":
         default_model = "moe-2b"

@@ -428,13 +428,23 @@ class RemoteProvider(Provider):
         self.model = model or DEFAULT_MODELS.get(provider, provider)
         self.api_key = api_key or self._resolve_key(provider)
         if not self.api_key:
-            if self.api_base:
-                self.api_key = "local"  # self-hosted endpoints often keyless
+            if self.api_base and self._is_loopback(self.api_base):
+                # self-hosted loopback endpoints are typically keyless; a
+                # REMOTE api_base without a key still fails loudly here
+                # rather than as an opaque 401 at first request
+                self.api_key = "local"
             else:
                 raise AuthenticationError(
                     f"no API key for provider {provider!r}: set "
                     f"{provider.upper()}_API_KEY or LLM_API_KEY"
                 )
+
+    @staticmethod
+    def _is_loopback(base: str) -> bool:
+        from urllib.parse import urlparse
+
+        host = urlparse(base).hostname or ""
+        return host in ("localhost", "127.0.0.1", "::1")
 
     @staticmethod
     def _resolve_key(provider: str) -> str | None:
@@ -508,11 +518,19 @@ class RemoteProvider(Provider):
         try:
             with urllib.request.urlopen(req, timeout=120) as resp:
                 body = json.loads(resp.read())
+            # error-shaped 200s ({"error": {...}} or empty choices) are a
+            # real pattern among OpenAI-compatible servers
+            if "error" in body:
+                raise ProviderError(
+                    f"remote endpoint error: {body['error']}"
+                )
+            msg = body["choices"][0]["message"]
+        except ProviderError:
+            raise
         except Exception as exc:  # noqa: BLE001
             raise ProviderError(
                 f"remote completion failed: {exc}", cause=exc
             ) from exc
-        msg = body["choices"][0]["message"]
         calls = [
             ToolCall(
                 tc.get("id", f"call_{uuid.uuid4().hex[:12]}"),

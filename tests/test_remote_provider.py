@@ -1,7 +1,7 @@
 """RemoteProvider without litellm: the dependency-free OpenAI-compatible
-urllib client against a loopback stub (BASELINE config #1's client path).
-The reference's transport is litellm HTTP dispatch
-(fei/core/assistant.py:524-530); this pins the in-tree equivalent."""
+urllib client against the shared loopback stub (BASELINE config #1's client
+path — the same stub the bench's remote suite measures, so the protocols
+cannot drift). Reference transport: fei/core/assistant.py:524-530."""
 
 from __future__ import annotations
 
@@ -12,72 +12,93 @@ import threading
 import pytest
 
 from fei_tpu.agent.providers import RemoteProvider
-from fei_tpu.utils.errors import ProviderError
+from fei_tpu.utils.errors import AuthenticationError, ProviderError
+from fei_tpu.utils.openai_stub import serve_openai_stub
 
 
-class _Stub(http.server.BaseHTTPRequestHandler):
-    last_payload: dict = {}
-
-    def do_POST(self):
-        raw = self.rfile.read(int(self.headers.get("Content-Length", 0)))
-        type(self).last_payload = json.loads(raw)
-        msg = {"role": "assistant", "content": "maildir names are immutable"}
-        if type(self).last_payload.get("tools"):
-            msg = {
-                "role": "assistant", "content": None,
-                "tool_calls": [{
-                    "id": "call_1", "type": "function",
-                    "function": {"name": "GlobTool",
-                                 "arguments": '{"pattern": "*.py"}'},
-                }],
-            }
-        body = json.dumps({
-            "choices": [{"message": msg, "finish_reason": "stop"}],
-            "usage": {"prompt_tokens": 5, "completion_tokens": 7},
-        }).encode()
-        self.send_response(200)
-        self.send_header("Content-Type", "application/json")
-        self.send_header("Content-Length", str(len(body)))
-        self.end_headers()
-        self.wfile.write(body)
-
-    def log_message(self, *args):
-        pass
+def _tool_responder(payload: dict):
+    usage = {"prompt_tokens": 5, "completion_tokens": 7, "total_tokens": 12}
+    if payload.get("tools"):
+        return (
+            {"role": "assistant", "content": None,
+             "tool_calls": [{
+                 "id": "call_1", "type": "function",
+                 "function": {"name": "GlobTool",
+                              "arguments": '{"pattern": "*.py"}'},
+             }]},
+            usage,
+        )
+    return {"role": "assistant", "content": "maildir names are immutable"}, usage
 
 
 @pytest.fixture()
-def stub_base():
-    server = http.server.ThreadingHTTPServer(("127.0.0.1", 0), _Stub)
-    threading.Thread(target=server.serve_forever, daemon=True).start()
-    yield f"http://127.0.0.1:{server.server_address[1]}/v1"
+def stub():
+    server, base = serve_openai_stub(responder=_tool_responder)
+    yield server, base
     server.shutdown()
 
 
 class TestRemoteProviderUrllib:
-    def test_plain_completion(self, stub_base):
-        p = RemoteProvider("openai", model="stub", api_base=stub_base)
+    def test_plain_completion(self, stub):
+        server, base = stub
+        p = RemoteProvider("openai", model="stub", api_base=base)
         resp = p.complete([{"role": "user", "content": "hi"}], system="sys")
         assert resp.content == "maildir names are immutable"
         assert resp.stop_reason == "stop"
         assert resp.usage["completion_tokens"] == 7
-        sent = _Stub.last_payload
-        assert sent["messages"][0] == {"role": "system", "content": "sys"}
+        assert server.last_payload["messages"][0] == {
+            "role": "system", "content": "sys"
+        }
 
-    def test_tool_call_parsing(self, stub_base):
-        p = RemoteProvider("openai", model="stub", api_base=stub_base)
+    def test_tool_call_parsing(self, stub):
+        server, base = stub
+        p = RemoteProvider("openai", model="stub", api_base=base)
         tools = [{"name": "GlobTool", "description": "find",
                   "input_schema": {"type": "object", "properties": {}}}]
         resp = p.complete([{"role": "user", "content": "find"}], tools=tools)
         assert resp.stop_reason == "tool_use"
         assert resp.tool_calls[0].name == "GlobTool"
         assert resp.tool_calls[0].arguments == {"pattern": "*.py"}
-        assert _Stub.last_payload["tools"][0]["function"]["name"] == "GlobTool"
+        sent = server.last_payload
+        assert sent["tools"][0]["function"]["name"] == "GlobTool"
 
-    def test_keyless_local_endpoint_allowed(self, stub_base, monkeypatch):
+    def test_keyless_loopback_endpoint_allowed(self, stub, monkeypatch):
+        _, base = stub
         for var in ("OPENAI_API_KEY", "LLM_API_KEY"):
             monkeypatch.delenv(var, raising=False)
-        p = RemoteProvider("openai", model="stub", api_base=stub_base)
+        p = RemoteProvider("openai", model="stub", api_base=base)
         assert p.api_key == "local"
+
+    def test_keyless_remote_endpoint_still_raises(self, monkeypatch):
+        for var in ("OPENAI_API_KEY", "LLM_API_KEY"):
+            monkeypatch.delenv(var, raising=False)
+        with pytest.raises(AuthenticationError):
+            RemoteProvider(
+                "openai", model="m", api_base="https://api.example.com/v1"
+            )
+
+    def test_error_shaped_200_surfaces_as_provider_error(self):
+        class ErrStub(http.server.BaseHTTPRequestHandler):
+            def do_POST(self):
+                self.rfile.read(int(self.headers.get("Content-Length", 0)))
+                body = json.dumps(
+                    {"error": {"message": "model overloaded"}}
+                ).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):
+                pass
+
+        server = http.server.ThreadingHTTPServer(("127.0.0.1", 0), ErrStub)
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        base = f"http://127.0.0.1:{server.server_address[1]}/v1"
+        p = RemoteProvider("openai", model="stub", api_base=base)
+        with pytest.raises(ProviderError, match="model overloaded"):
+            p.complete([{"role": "user", "content": "hi"}])
+        server.shutdown()
 
     def test_no_litellm_no_base_raises(self, monkeypatch):
         monkeypatch.delenv("OPENAI_API_BASE", raising=False)
