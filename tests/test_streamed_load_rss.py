@@ -1,0 +1,121 @@
+"""70B-shaped streamed-load rehearsal under an explicit host-RSS budget
+(VERDICT round-2 item 9).
+
+engine/weights.py claims the streamed sharded path never materializes the
+full checkpoint on host (the property that lets ~140 GB of 70B weights
+load onto a pod from a smaller host). The measurement runs in a SUBPROCESS
+so the ru_maxrss high-water mark starts clean — in-process measurement is
+vacuous (the checkpoint writer itself, or any earlier suite test, raises
+the watermark past the budget being asserted). Inside the subprocess:
+
+1. STREAMED first: peak-RSS growth must stay within a budget of the final
+   resident parameter bytes (on the virtual CPU mesh the device shards ARE
+   host memory, so the budget is params x factor, not a small constant);
+2. EAGER second: the whole-tensor host materialization must push the
+   high-water mark measurably further — the comparative signal that the
+   streamed path really skips the host copy.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from fei_tpu.models.configs import get_model_config
+
+safetensors = pytest.importorskip("safetensors.numpy")
+
+from tests.test_streamed_load import _write_hf_llama  # noqa: E402
+
+_CFG_KW = dict(
+    num_layers=10, hidden_size=1024, intermediate_size=3584,
+    num_heads=16, num_kv_heads=8, vocab_size=4096, max_seq_len=256,
+)
+
+_CHILD = r"""
+import gc, json, resource, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+
+from fei_tpu.engine.weights import load_checkpoint
+from fei_tpu.models.configs import get_model_config
+from fei_tpu.parallel.mesh import make_mesh
+from fei_tpu.parallel.sharding import param_shardings_from_cfg
+
+ckpt, cfg_kw = sys.argv[1], json.loads(sys.argv[2])
+
+def maxrss():
+    ru = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    return ru * 1024 if sys.platform.startswith("linux") else ru
+
+cfg = get_model_config("llama3-70b", **cfg_kw)
+n = min(8, len(jax.devices()))
+mesh = make_mesh({"tp": n}, devices=jax.devices()[:n])
+shardings = param_shardings_from_cfg(cfg, mesh)
+
+gc.collect()
+wm0 = maxrss()
+_, params = load_checkpoint(ckpt, cfg, dtype=jnp.float32, shardings=shardings)
+jax.block_until_ready(params)
+pbytes = sum(x.nbytes for x in jax.tree_util.tree_leaves(params)
+             if hasattr(x, "nbytes"))
+wm1 = maxrss()
+del params
+gc.collect()
+_, eager = load_checkpoint(ckpt, cfg, dtype=jnp.float32)
+jax.block_until_ready(eager)
+wm2 = maxrss()
+del eager
+print(json.dumps({
+    "pbytes": pbytes,
+    "streamed_delta": wm1 - wm0,
+    "eager_extra": wm2 - wm1,
+}))
+"""
+
+
+class TestStreamedLoadRss:
+    def test_70b_shaped_load_stays_in_rss_budget(self, tmp_path):
+        # llama3-70b ratios (GQA 8 kv heads, 3.5x mlp) scaled: the
+        # checkpoint is ~0.5 GB fp32 — big enough that a stray full-host
+        # copy moves the subprocess's clean high-water mark unambiguously
+        cfg = get_model_config("llama3-70b", **_CFG_KW)
+        _write_hf_llama(tmp_path, cfg)
+
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = (
+            env.get("XLA_FLAGS", "")
+            .replace("--xla_force_host_platform_device_count=8", "")
+            + " --xla_force_host_platform_device_count=8"
+        ).strip()
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        out = subprocess.run(
+            [sys.executable, "-c", _CHILD, str(tmp_path), json.dumps(_CFG_KW)],
+            capture_output=True, text=True, timeout=420, env=env, cwd=repo,
+        )
+        assert out.returncode == 0, out.stderr[-2000:]
+        stats = json.loads(out.stdout.strip().splitlines()[-1])
+        pbytes = stats["pbytes"]
+        assert pbytes > 3e8, f"model too small for signal: {pbytes/1e9:.2f} GB"
+
+        # budget: final resident shards + bounded per-slice staging. A full
+        # host materialization (pbytes staged on host + pbytes resident)
+        # would land near 2x; mmap page-cache residency adds noise -> 1.6
+        assert stats["streamed_delta"] < 1.6 * pbytes, (
+            f"streamed load grew RSS by {stats['streamed_delta']/1e9:.2f} GB "
+            f"for {pbytes/1e9:.2f} GB of params — a full host copy leaked in"
+        )
+        # the eager path materializes every tensor whole on host before
+        # device_put — it must push the high-water mark beyond what the
+        # streamed pass ever needed
+        assert stats["eager_extra"] > 0.2 * pbytes, (
+            f"eager load only grew RSS by {stats['eager_extra']/1e9:.2f} GB "
+            "over the streamed peak — the comparison lost its signal"
+        )
