@@ -308,24 +308,36 @@ def forward_paged_block(
 
     All T tokens' projections/MLP batch into single matmuls (one weight
     read for T tokens — the point of speculation on a weight-streaming-
-    bound decode), their K/V scatter into the sequence's pool pages, and
-    each position attends pool history + the block prefix via T unrolled
-    invocations of the single-query ragged kernel. T is small (1 +
-    draft_len); a true multi-query paged kernel would read history once
-    instead of T times and is the natural next optimization. Returns
-    (logits [B, T, V] fp32, cache with lengths += T). The CALLER owns
-    rollback: only the accepted prefix's K/V is real — shrink ``lengths``
-    to mask the rest, exactly like the dense lookahead path.
+    bound decode) and their K/V scatter into the sequence's pool pages.
+    Attention uses the multi-query block kernel
+    (ops.pallas.paged_attention_block): pool history is read ONCE for the
+    whole block with per-row causal limits. FEI_TPU_BLOCK_ATTN=0 falls
+    back to T unrolled single-query kernel calls; T=1 (plain decode)
+    always takes the single-query kernel already validated under Mosaic.
+    Returns (logits [B, T, V] fp32, cache with lengths += T). The CALLER
+    owns rollback: only the accepted prefix's K/V is real — shrink
+    ``lengths`` to mask the rest, exactly like the dense lookahead path.
     """
     from fei_tpu.engine.paged_cache import write_token_kv
     from fei_tpu.ops.pallas import paged_attention
-    from fei_tpu.ops.pallas.paged_attention import paged_attention_sharded
+    from fei_tpu.ops.pallas.paged_attention import (
+        paged_attention_block,
+        paged_attention_block_sharded,
+        paged_attention_sharded,
+    )
 
     B, T = tokens.shape
     K, d, Hq = cfg.num_kv_heads, cfg.head_dim_, cfg.num_heads
     positions = cache.lengths[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
     max_pos = cache.block_table.shape[1] * cache.page_size
     cos, sin = compute_rope_freqs(cfg.head_dim_, max_pos, cfg.rope_theta)
+    # the multi-query kernel reads pool history ONCE for the whole block
+    # (vs T reads for T single-query calls); FEI_TPU_BLOCK_ATTN=0 falls
+    # back to the per-position loop (e.g. if Mosaic rejects the block
+    # tile). T=1 (plain decode) always uses the single-query kernel — the
+    # one already validated under Mosaic on-chip.
+    block_kernel = T > 1 and os.environ.get("FEI_TPU_BLOCK_ATTN", "1") != "0"
+    sharded = kernel_mesh is not None and kernel_mesh.shape.get("tp", 1) > 1
 
     kv_int8 = cache.k_scales is not None
     dtype = params["embed"].dtype if kv_int8 else cache.k_pages.dtype
@@ -344,8 +356,9 @@ def forward_paged_block(
         q = apply_rope(q, cos, sin, positions)
         k = apply_rope(k, cos, sin, positions)
 
-        attns = []
-        for i in range(T):  # static unroll — page writes then attention
+        # write all T positions' K/V (causality is the kernel's per-row
+        # mask, so writing ahead of attending is safe)
+        for i in range(T):
             written = write_token_kv(
                 kp, vp, k[:, i], v[:, i], cache.block_table,
                 cache.lengths + i, k_scales=ksc, v_scales=vsc,
@@ -354,19 +367,33 @@ def forward_paged_block(
                 kp, vp, ksc, vsc = written
             else:
                 kp, vp = written
-            if kernel_mesh is not None and kernel_mesh.shape.get("tp", 1) > 1:
-                a = paged_attention_sharded(
-                    q[:, i], kp, vp, cache.block_table,
-                    cache.lengths + i + 1, kernel_mesh, axis_name="tp",
-                    k_scales=ksc, v_scales=vsc,
+        if block_kernel:
+            if sharded:
+                attn = paged_attention_block_sharded(
+                    q, kp, vp, cache.block_table, cache.lengths,
+                    kernel_mesh, axis_name="tp", k_scales=ksc, v_scales=vsc,
                 )
             else:
-                a = paged_attention(
-                    q[:, i], kp, vp, cache.block_table,
-                    cache.lengths + i + 1, k_scales=ksc, v_scales=vsc,
-                )  # [B, Hq, D]
-            attns.append(a)
-        attn = jnp.stack(attns, axis=1)  # [B, T, Hq, D]
+                attn = paged_attention_block(
+                    q, kp, vp, cache.block_table, cache.lengths,
+                    k_scales=ksc, v_scales=vsc,
+                )  # [B, T, Hq, D]
+        else:
+            attns = []
+            for i in range(T):  # per-position fallback
+                if sharded:
+                    a = paged_attention_sharded(
+                        q[:, i], kp, vp, cache.block_table,
+                        cache.lengths + i + 1, kernel_mesh, axis_name="tp",
+                        k_scales=ksc, v_scales=vsc,
+                    )
+                else:
+                    a = paged_attention(
+                        q[:, i], kp, vp, cache.block_table,
+                        cache.lengths + i + 1, k_scales=ksc, v_scales=vsc,
+                    )  # [B, Hq, D]
+                attns.append(a)
+            attn = jnp.stack(attns, axis=1)  # [B, T, Hq, D]
         x = x + mm(attn.reshape(B, T, Hq * d), lp["wo"])
 
         y = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)

@@ -36,14 +36,24 @@ NEG_INF = -1e30
 def _decode_kernel(
     # scalar prefetch
     block_table_ref,  # [B, max_pages] page index per (seq, slot)
-    length_ref,  # [B] valid kv length per sequence
-    # blocks: q [1,1,G,D], k/v [1,1,page_size,D]; int8 pools add
-    # ks/vs [1,1,1,page_size] per-slot scale rows before o [1,1,G,D]
+    length_ref,  # [B] valid kv length for the FIRST query row
+    # blocks: q [1,1,qt*G,D], k/v [1,1,page_size,D]; int8 pools add
+    # ks/vs [1,1,1,page_size] per-slot scale rows before o [1,1,qt*G,D]
     *refs,
     page_size: int,
     scale: float,
     kv_int8: bool,
+    qt: int = 1,
+    g: int = 1,
 ):
+    """Online-softmax paged attention over one (seq, kv-head) tile.
+
+    ``qt`` is the query-block length: qt consecutive query positions share
+    one kernel invocation (speculative verification / block decode), each
+    row r attending kv positions < length + r//g — the per-row causal
+    limit. qt=1 with length = kv_len+1 is plain single-token decode; the
+    pool history is read ONCE for the whole block either way.
+    """
     if kv_int8:
         q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = refs
     else:
@@ -61,9 +71,9 @@ def _decode_kernel(
 
     length = length_ref[b]
 
-    @pl.when(pi * page_size < length)
+    @pl.when(pi * page_size < length + (qt - 1))
     def _compute():
-        q = q_ref[0, 0]  # [G, D]
+        q = q_ref[0, 0]  # [qt*G, D]
         k = k_ref[0, 0]  # [page_size, D]
         v = v_ref[0, 0]
 
@@ -71,16 +81,18 @@ def _decode_kernel(
             q, k.astype(q.dtype) if kv_int8 else k,
             dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        ) * scale  # [G, page_size]
+        ) * scale  # [qt*G, page_size]
         if kv_int8:
             # dequant folds into the score row: k_slot scale is constant
             # along the contracted D axis, so (q·k_int8)·ks == q·(k_int8·ks)
-            s = s * ks_ref[0, 0]  # [1, page_size] broadcasts over G
+            s = s * ks_ref[0, 0]  # [1, page_size] broadcasts over rows
 
         pos = pi * page_size + jax.lax.broadcasted_iota(
             jnp.int32, s.shape, 1
         )
-        s = jnp.where(pos < length, s, NEG_INF)
+        # per-row causal limit: row r is query position (length-1) + r//g
+        row_t = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) // g
+        s = jnp.where(pos < length + row_t, s, NEG_INF)
 
         m_prev = m_ref[:]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
@@ -109,6 +121,70 @@ def _decode_kernel(
         o_ref[0, 0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
 
 
+def _paged_call(
+    qg: jnp.ndarray,  # [B, K, qt*g, D] position-major, group-minor rows
+    k_pages: jnp.ndarray,
+    v_pages: jnp.ndarray,
+    block_table: jnp.ndarray,
+    limits: jnp.ndarray,  # [B] first-row causal limit (kv positions < it)
+    *,
+    qt: int,
+    g: int,
+    scale: float,
+    interpret: bool,
+    k_scales: jnp.ndarray | None,
+    v_scales: jnp.ndarray | None,
+) -> jnp.ndarray:
+    """Shared pallas_call plumbing for the single-query and block wrappers
+    — ONE assembly of specs/grid/scratch so the two paths cannot drift."""
+    B, K, rows, D = qg.shape
+    page_size = k_pages.shape[2]
+    max_pages = block_table.shape[1]
+    kv_int8 = k_scales is not None
+
+    kernel = functools.partial(
+        _decode_kernel, page_size=page_size, scale=scale, kv_int8=kv_int8,
+        qt=qt, g=g,
+    )
+    page_spec = pl.BlockSpec(
+        (1, 1, page_size, D),
+        lambda b, kh, pi, bt, ln: (bt[b, pi], kh, 0, 0),
+    )
+    scale_spec = pl.BlockSpec(
+        (1, 1, 1, page_size),
+        lambda b, kh, pi, bt, ln: (bt[b, pi], kh, 0, 0),
+    )
+    row_spec = pl.BlockSpec(
+        (1, 1, rows, D),
+        lambda b, kh, pi, bt, ln: (b, kh, 0, 0),
+    )
+    in_specs = [row_spec, page_spec, page_spec]
+    args = [qg, k_pages, v_pages]
+    if kv_int8:
+        in_specs += [scale_spec, scale_spec]
+        args += [k_scales, v_scales]
+
+    return pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(B, K, max_pages),
+            in_specs=in_specs,
+            out_specs=row_spec,
+            scratch_shapes=[
+                pltpu.VMEM((rows, 1), jnp.float32),
+                pltpu.VMEM((rows, 1), jnp.float32),
+                pltpu.VMEM((rows, D), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, K, rows, D), qg.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(block_table.astype(jnp.int32), limits.astype(jnp.int32), *args)
+
+
 @functools.partial(
     jax.jit, static_argnames=("scale", "interpret")
 )
@@ -130,67 +206,95 @@ def paged_attention(
     the per-slot scales fold into the score row / p matrix exactly.
     """
     B, H, D = q.shape
-    K, page_size = k_pages.shape[1], k_pages.shape[2]
+    K = k_pages.shape[1]
     G = H // K
-    max_pages = block_table.shape[1]
     if scale is None:
         scale = D ** -0.5
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    kv_int8 = k_scales is not None
 
     # group-major so each q tile is this kv head's (G, D) block
     qg = q.reshape(B, K, G, D)
+    out = _paged_call(
+        qg, k_pages, v_pages, block_table, lengths,
+        qt=1, g=G, scale=scale, interpret=interpret,
+        k_scales=k_scales, v_scales=v_scales,
+    )
+    return out.reshape(B, H, D)
 
-    kernel = functools.partial(
-        _decode_kernel, page_size=page_size, scale=scale, kv_int8=kv_int8
-    )
 
-    page_spec = pl.BlockSpec(
-        (1, 1, page_size, D),
-        lambda b, kh, pi, bt, ln: (bt[b, pi], kh, 0, 0),
+@functools.partial(
+    jax.jit, static_argnames=("scale", "interpret")
+)
+def paged_attention_block(
+    q: jnp.ndarray,  # [B, T, H, D] — T consecutive query positions per seq
+    k_pages: jnp.ndarray,  # [P, K, page_size, D] shared page pool
+    v_pages: jnp.ndarray,
+    block_table: jnp.ndarray,  # [B, max_pages] int32
+    lengths: jnp.ndarray,  # [B] int32 kv length BEFORE the block
+    scale: float | None = None,
+    interpret: bool | None = None,
+    k_scales: jnp.ndarray | None = None,
+    v_scales: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """Multi-query paged attention for speculative verification / block
+    decode. The T positions' K/V must already be written into the pool
+    (positions lengths..lengths+T-1); per-row causal masking keeps query t
+    from seeing positions beyond lengths+t. Pool history is read ONCE for
+    the whole block — vs T reads for T single-token calls. Returns
+    [B, T, H, D]."""
+    B, T, H, D = q.shape
+    K = k_pages.shape[1]
+    G = H // K
+    if scale is None:
+        scale = D ** -0.5
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    # rows = t*G + g: query position-major, head-group-minor, so the
+    # kernel's row//G recovers t for the causal limit; the first row's
+    # limit is lengths + 1 (its own position included)
+    qg = jnp.swapaxes(q.reshape(B, T, K, G, D), 1, 2).reshape(B, K, T * G, D)
+    out = _paged_call(
+        qg, k_pages, v_pages, block_table, lengths + 1,
+        qt=T, g=G, scale=scale, interpret=interpret,
+        k_scales=k_scales, v_scales=v_scales,
     )
-    scale_spec = pl.BlockSpec(
-        (1, 1, 1, page_size),
-        lambda b, kh, pi, bt, ln: (bt[b, pi], kh, 0, 0),
-    )
-    in_specs = [
-        pl.BlockSpec(
-            (1, 1, G, D),
-            lambda b, kh, pi, bt, ln: (b, kh, 0, 0),
-        ),
-        page_spec,
-        page_spec,
-    ]
-    args = [qg, k_pages, v_pages]
-    if kv_int8:
-        in_specs += [scale_spec, scale_spec]
+    return jnp.swapaxes(out.reshape(B, K, T, G, D), 1, 2).reshape(B, T, H, D)
+
+
+def _sharded_paged(
+    local_fn,
+    head_spec,
+    q, k_pages, v_pages, block_table, lengths, mesh, axis_name,
+    k_scales, v_scales,
+):
+    """Shared shard_map wrapper: XLA cannot auto-partition a pallas_call,
+    so kv heads (and the query head groups attending to them) shard over
+    ``axis_name`` and each device runs the kernel on its local pool slice."""
+    from jax.sharding import PartitionSpec as P
+
+    n = mesh.shape[axis_name]
+    K = k_pages.shape[1]
+    if K % n:
+        raise ValueError(f"kv heads {K} must divide {axis_name} axis {n}")
+    page_spec = P(None, axis_name, None, None)
+    in_specs = [head_spec, page_spec, page_spec, P(), P()]
+    args = [q, k_pages, v_pages, block_table, lengths]
+    if k_scales is not None:
+        in_specs += [page_spec, page_spec]
         args += [k_scales, v_scales]
 
-    out = pl.pallas_call(
-        kernel,
-        grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=2,
-            grid=(B, K, max_pages),
-            in_specs=in_specs,
-            out_specs=pl.BlockSpec(
-                (1, 1, G, D),
-                lambda b, kh, pi, bt, ln: (b, kh, 0, 0),
-            ),
-            scratch_shapes=[
-                pltpu.VMEM((G, 1), jnp.float32),
-                pltpu.VMEM((G, 1), jnp.float32),
-                pltpu.VMEM((G, D), jnp.float32),
-            ],
-        ),
-        out_shape=jax.ShapeDtypeStruct((B, K, G, D), q.dtype),
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary"),
-        ),
-        interpret=interpret,
-    )(block_table.astype(jnp.int32), lengths.astype(jnp.int32), *args)
+    def body(q, kp, vp, bt, ln, *scales):
+        ks, vs = scales if scales else (None, None)
+        return local_fn(q, kp, vp, bt, ln, k_scales=ks, v_scales=vs)
 
-    return out.reshape(B, H, D)
+    fn = jax.shard_map(
+        body, mesh=mesh, in_specs=tuple(in_specs), out_specs=head_spec,
+        # the vma checker can't see through a pallas_call's output
+        check_vma=False,
+    )
+    return fn(*args)
 
 
 def paged_attention_sharded(
@@ -204,33 +308,32 @@ def paged_attention_sharded(
     k_scales: jnp.ndarray | None = None,
     v_scales: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
-    """Tensor-parallel paged attention: XLA cannot auto-partition a
-    pallas_call, so the kernel runs under shard_map with kv heads (and the
-    query head groups that attend to them) sharded over ``axis_name`` —
-    each device attends over its local slice of the page pool. Composable
-    inside an outer jit; inputs already laid out this way reshard for free.
-    """
+    """Tensor-parallel single-token paged attention (see _sharded_paged)."""
     from jax.sharding import PartitionSpec as P
 
-    n = mesh.shape[axis_name]
-    K = k_pages.shape[1]
-    if K % n:
-        raise ValueError(f"kv heads {K} must divide {axis_name} axis {n}")
-    head_spec = P(None, axis_name, None)  # q/out: heads sharded
-    page_spec = P(None, axis_name, None, None)
-    in_specs = [head_spec, page_spec, page_spec, P(), P()]
-    args = [q, k_pages, v_pages, block_table, lengths]
-    if k_scales is not None:
-        in_specs += [page_spec, page_spec]
-        args += [k_scales, v_scales]
-
-    def body(q, kp, vp, bt, ln, *scales):
-        ks, vs = scales if scales else (None, None)
-        return paged_attention(q, kp, vp, bt, ln, k_scales=ks, v_scales=vs)
-
-    fn = jax.shard_map(
-        body, mesh=mesh, in_specs=tuple(in_specs), out_specs=head_spec,
-        # the vma checker can't see through a pallas_call's output
-        check_vma=False,
+    return _sharded_paged(
+        paged_attention, P(None, axis_name, None),
+        q, k_pages, v_pages, block_table, lengths, mesh, axis_name,
+        k_scales, v_scales,
     )
-    return fn(*args)
+
+
+def paged_attention_block_sharded(
+    q: jnp.ndarray,  # [B, T, H, D]
+    k_pages: jnp.ndarray,
+    v_pages: jnp.ndarray,
+    block_table: jnp.ndarray,
+    lengths: jnp.ndarray,
+    mesh,
+    axis_name: str = "tp",
+    k_scales: jnp.ndarray | None = None,
+    v_scales: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """Tensor-parallel multi-query paged attention (see _sharded_paged)."""
+    from jax.sharding import PartitionSpec as P
+
+    return _sharded_paged(
+        paged_attention_block, P(None, None, axis_name, None),
+        q, k_pages, v_pages, block_table, lengths, mesh, axis_name,
+        k_scales, v_scales,
+    )

@@ -228,3 +228,100 @@ class TestPagedAttention:
         want = attention(q[:, None], kc, vc, positions, lengths)[:, 0]
         got = paged_attention(q, kp, vp, bt, lengths)
         np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=_atol())
+
+
+class TestPagedBlockAttention:
+    """Multi-query block kernel (speculative verification): per-row causal
+    limits over the paged pool, history read once for the whole block."""
+
+    def _setup(self, key, B, T, H, K, D, page_size, pps, lengths):
+        ks = jax.random.split(key, 3)
+        P = B * pps + 1
+        k_pages = _rand(ks[0], (P, K, page_size, D))
+        v_pages = _rand(ks[1], (P, K, page_size, D))
+        rng = np.random.default_rng(7)
+        perm = rng.permutation(np.arange(1, P))
+        table = perm[: B * pps].reshape(B, pps)
+        block_table = jnp.asarray(table, dtype=jnp.int32)
+        q = _rand(ks[2], (B, T, H, D))
+        return q, k_pages, v_pages, block_table
+
+    def _per_position_oracle(self, q, kp, vp, bt, base, **scales):
+        """T single-query kernel calls — the exact semantics the block
+        kernel must reproduce (same pool state, incremented limits)."""
+        B, T, H, D = q.shape
+        outs = [
+            paged_attention(q[:, i], kp, vp, bt, base + i + 1, **scales)
+            for i in range(T)
+        ]
+        return jnp.stack(outs, axis=1)
+
+    def test_matches_per_position(self):
+        from fei_tpu.ops.pallas.paged_attention import paged_attention_block
+
+        B, T, H, K, D, ps, pps = 2, 5, 4, 2, 64, 16, 4
+        base = jnp.array([33, 11], dtype=jnp.int32)  # kv before the block
+        q, kp, vp, bt = self._setup(
+            jax.random.PRNGKey(3), B, T, H, K, D, ps, pps, base
+        )
+        want = self._per_position_oracle(q, kp, vp, bt, base)
+        got = paged_attention_block(q, kp, vp, bt, base)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=_atol())
+
+    def test_t1_equals_single_query(self):
+        from fei_tpu.ops.pallas.paged_attention import paged_attention_block
+
+        B, T, H, K, D, ps, pps = 1, 1, 4, 4, 32, 8, 3
+        base = jnp.array([13], dtype=jnp.int32)
+        q, kp, vp, bt = self._setup(
+            jax.random.PRNGKey(4), B, T, H, K, D, ps, pps, base
+        )
+        want = paged_attention(q[:, 0], kp, vp, bt, base + 1)
+        got = paged_attention_block(q, kp, vp, bt, base)[:, 0]
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=_atol())
+
+    def test_int8_pool(self):
+        from fei_tpu.ops.pallas.paged_attention import paged_attention_block
+
+        B, T, H, K, D, ps, pps = 2, 3, 4, 2, 32, 8, 4
+        base = jnp.array([9, 20], dtype=jnp.int32)
+        q, kp, vp, bt = self._setup(
+            jax.random.PRNGKey(5), B, T, H, K, D, ps, pps, base
+        )
+
+        def rowquant(pages):
+            # per-(page, head, slot) symmetric int8 over D — the pool's
+            # storage layout, scales [P, K, 1, ps]
+            amax = jnp.max(jnp.abs(pages), axis=-1, keepdims=True)
+            s = jnp.where(amax == 0, 1.0, amax / 127.0)
+            qv = jnp.clip(jnp.round(pages / s), -127, 127).astype(jnp.int8)
+            return qv, jnp.moveaxis(s, -1, -2)
+
+        kq, ksc = rowquant(kp)
+        vq, vsc = rowquant(vp)
+        want = self._per_position_oracle(
+            q, kq, vq, bt, base, k_scales=ksc, v_scales=vsc
+        )
+        got = paged_attention_block(
+            q, kq, vq, bt, base, k_scales=ksc, v_scales=vsc
+        )
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=_atol())
+
+    def test_sharded_matches_local(self):
+        from fei_tpu.ops.pallas.paged_attention import (
+            paged_attention_block,
+            paged_attention_block_sharded,
+        )
+        from fei_tpu.parallel.mesh import make_mesh
+
+        if len(jax.devices()) < 2:
+            pytest.skip("needs 2 devices")
+        B, T, H, K, D, ps, pps = 2, 4, 4, 2, 32, 8, 4
+        base = jnp.array([21, 6], dtype=jnp.int32)
+        q, kp, vp, bt = self._setup(
+            jax.random.PRNGKey(6), B, T, H, K, D, ps, pps, base
+        )
+        mesh = make_mesh({"tp": 2}, devices=jax.devices()[:2])
+        want = paged_attention_block(q, kp, vp, bt, base)
+        got = paged_attention_block_sharded(q, kp, vp, bt, base, mesh)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=_atol())
