@@ -331,11 +331,7 @@ def forward_paged_block(
     positions = cache.lengths[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
     max_pos = cache.block_table.shape[1] * cache.page_size
     cos, sin = compute_rope_freqs(cfg.head_dim_, max_pos, cfg.rope_theta)
-    # the multi-query kernel reads pool history ONCE for the whole block
-    # (vs T reads for T single-query calls); FEI_TPU_BLOCK_ATTN=0 falls
-    # back to the per-position loop (e.g. if Mosaic rejects the block
-    # tile). T=1 (plain decode) always uses the single-query kernel — the
-    # one already validated under Mosaic on-chip.
+    # kernel-selection policy: see the docstring
     block_kernel = T > 1 and os.environ.get("FEI_TPU_BLOCK_ATTN", "1") != "0"
     sharded = kernel_mesh is not None and kernel_mesh.shape.get("tp", 1) > 1
 

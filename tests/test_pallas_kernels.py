@@ -234,7 +234,7 @@ class TestPagedBlockAttention:
     """Multi-query block kernel (speculative verification): per-row causal
     limits over the paged pool, history read once for the whole block."""
 
-    def _setup(self, key, B, T, H, K, D, page_size, pps, lengths):
+    def _setup(self, key, B, T, H, K, D, page_size, pps):
         ks = jax.random.split(key, 3)
         P = B * pps + 1
         k_pages = _rand(ks[0], (P, K, page_size, D))
@@ -262,7 +262,7 @@ class TestPagedBlockAttention:
         B, T, H, K, D, ps, pps = 2, 5, 4, 2, 64, 16, 4
         base = jnp.array([33, 11], dtype=jnp.int32)  # kv before the block
         q, kp, vp, bt = self._setup(
-            jax.random.PRNGKey(3), B, T, H, K, D, ps, pps, base
+            jax.random.PRNGKey(3), B, T, H, K, D, ps, pps
         )
         want = self._per_position_oracle(q, kp, vp, bt, base)
         got = paged_attention_block(q, kp, vp, bt, base)
@@ -274,7 +274,7 @@ class TestPagedBlockAttention:
         B, T, H, K, D, ps, pps = 1, 1, 4, 4, 32, 8, 3
         base = jnp.array([13], dtype=jnp.int32)
         q, kp, vp, bt = self._setup(
-            jax.random.PRNGKey(4), B, T, H, K, D, ps, pps, base
+            jax.random.PRNGKey(4), B, T, H, K, D, ps, pps
         )
         want = paged_attention(q[:, 0], kp, vp, bt, base + 1)
         got = paged_attention_block(q, kp, vp, bt, base)[:, 0]
@@ -286,7 +286,7 @@ class TestPagedBlockAttention:
         B, T, H, K, D, ps, pps = 2, 3, 4, 2, 32, 8, 4
         base = jnp.array([9, 20], dtype=jnp.int32)
         q, kp, vp, bt = self._setup(
-            jax.random.PRNGKey(5), B, T, H, K, D, ps, pps, base
+            jax.random.PRNGKey(5), B, T, H, K, D, ps, pps
         )
 
         def rowquant(pages):
@@ -319,7 +319,7 @@ class TestPagedBlockAttention:
         B, T, H, K, D, ps, pps = 2, 4, 4, 2, 32, 8, 4
         base = jnp.array([21, 6], dtype=jnp.int32)
         q, kp, vp, bt = self._setup(
-            jax.random.PRNGKey(6), B, T, H, K, D, ps, pps, base
+            jax.random.PRNGKey(6), B, T, H, K, D, ps, pps
         )
         mesh = make_mesh({"tp": 2}, devices=jax.devices()[:2])
         want = paged_attention_block(q, kp, vp, bt, base)
