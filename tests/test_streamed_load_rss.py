@@ -3,17 +3,17 @@
 
 engine/weights.py claims the streamed sharded path never materializes the
 full checkpoint on host (the property that lets ~140 GB of 70B weights
-load onto a pod from a smaller host). The measurement runs in a SUBPROCESS
-so the ru_maxrss high-water mark starts clean — in-process measurement is
-vacuous (the checkpoint writer itself, or any earlier suite test, raises
-the watermark past the budget being asserted). Inside the subprocess:
+load onto a pod from a smaller host). Each load mode runs in its OWN
+SUBPROCESS so its ru_maxrss high-water mark starts clean — a shared
+watermark (in-process, or both modes in one child) is allocator-dependent
+and vacuous under suite load. The two clean peaks are then compared:
 
-1. STREAMED first: peak-RSS growth must stay within a budget of the final
+1. STREAMED: peak-RSS growth must land between ~1x and 1.6x the final
    resident parameter bytes (on the virtual CPU mesh the device shards ARE
-   host memory, so the budget is params x factor, not a small constant);
-2. EAGER second: the whole-tensor host materialization must push the
-   high-water mark measurably further — the comparative signal that the
-   streamed path really skips the host copy.
+   host memory — the lower bound also catches a lazy/mmap regression that
+   materializes nothing);
+2. EAGER: its independent clean peak must exceed the streamed peak by a
+   clear ratio — the whole-tensor host staging the streamed path skips.
 """
 
 from __future__ import annotations
@@ -58,24 +58,18 @@ n = min(8, len(jax.devices()))
 mesh = make_mesh({"tp": n}, devices=jax.devices()[:n])
 shardings = param_shardings_from_cfg(cfg, mesh)
 
+mode = sys.argv[3]
 gc.collect()
 wm0 = maxrss()
-_, params = load_checkpoint(ckpt, cfg, dtype=jnp.float32, shardings=shardings)
+if mode == "streamed":
+    _, params = load_checkpoint(ckpt, cfg, dtype=jnp.float32, shardings=shardings)
+else:
+    _, params = load_checkpoint(ckpt, cfg, dtype=jnp.float32)
 jax.block_until_ready(params)
 pbytes = sum(x.nbytes for x in jax.tree_util.tree_leaves(params)
              if hasattr(x, "nbytes"))
 wm1 = maxrss()
-del params
-gc.collect()
-_, eager = load_checkpoint(ckpt, cfg, dtype=jnp.float32)
-jax.block_until_ready(eager)
-wm2 = maxrss()
-del eager
-print(json.dumps({
-    "pbytes": pbytes,
-    "streamed_delta": wm1 - wm0,
-    "eager_extra": wm2 - wm1,
-}))
+print(json.dumps({"pbytes": pbytes, "delta": wm1 - wm0}))
 """
 
 
@@ -96,26 +90,41 @@ class TestStreamedLoadRss:
         ).strip()
         repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
-        out = subprocess.run(
-            [sys.executable, "-c", _CHILD, str(tmp_path), json.dumps(_CFG_KW)],
-            capture_output=True, text=True, timeout=420, env=env, cwd=repo,
-        )
-        assert out.returncode == 0, out.stderr[-2000:]
-        stats = json.loads(out.stdout.strip().splitlines()[-1])
-        pbytes = stats["pbytes"]
+        def run(mode: str) -> dict:
+            out = subprocess.run(
+                [sys.executable, "-c", _CHILD, str(tmp_path),
+                 json.dumps(_CFG_KW), mode],
+                capture_output=True, text=True, timeout=420, env=env, cwd=repo,
+            )
+            assert out.returncode == 0, out.stderr[-2000:]
+            return json.loads(out.stdout.strip().splitlines()[-1])
+
+        streamed = run("streamed")
+        eager = run("eager")
+        pbytes = streamed["pbytes"]
         assert pbytes > 3e8, f"model too small for signal: {pbytes/1e9:.2f} GB"
 
-        # budget: final resident shards + bounded per-slice staging. A full
-        # host materialization (pbytes staged on host + pbytes resident)
-        # would land near 2x; mmap page-cache residency adds noise -> 1.6
-        assert stats["streamed_delta"] < 1.6 * pbytes, (
-            f"streamed load grew RSS by {stats['streamed_delta']/1e9:.2f} GB "
+        # budget: final resident shards + bounded per-slice staging.
+        # Measured 1.24-1.27x across runs; the eager path (whole stacked
+        # tensors staged on host one at a time) measures 1.44x, so 1.35
+        # cleanly separates the two while leaving noise headroom
+        assert streamed["delta"] < 1.35 * pbytes, (
+            f"streamed load grew RSS by {streamed['delta']/1e9:.2f} GB "
             f"for {pbytes/1e9:.2f} GB of params — a full host copy leaked in"
         )
-        # the eager path materializes every tensor whole on host before
-        # device_put — it must push the high-water mark beyond what the
-        # streamed pass ever needed
-        assert stats["eager_extra"] > 0.2 * pbytes, (
-            f"eager load only grew RSS by {stats['eager_extra']/1e9:.2f} GB "
-            "over the streamed peak — the comparison lost its signal"
+        # the shards really are resident host memory on the CPU mesh: a
+        # lazy/mmap regression that materializes nothing would make BOTH
+        # deltas tiny and the ratio check below vacuous
+        assert streamed["delta"] > 0.8 * pbytes, (
+            f"streamed load grew RSS by only {streamed['delta']/1e9:.2f} GB "
+            f"for {pbytes/1e9:.2f} GB of params — nothing materialized?"
+        )
+        # the eager path stages each whole stacked tensor on host before
+        # device_put — measured in its OWN subprocess (a shared watermark
+        # comparison is allocator-dependent and flaked under suite load),
+        # its clean peak exceeds the streamed pass's by the largest-tensor
+        # margin (measured ratio 1.14-1.17; 1.1 leaves noise headroom)
+        assert eager["delta"] > 1.1 * streamed["delta"], (
+            f"eager peak {eager['delta']/1e9:.2f} GB not above streamed "
+            f"peak {streamed['delta']/1e9:.2f} GB — comparison lost signal"
         )
