@@ -599,12 +599,11 @@ def main() -> int:
     if suite == "remote":
         # client-path baseline: no device backend involved at all
         return bench_remote(min(n_tokens, 256))
-    if os.environ.get("JAX_PLATFORMS"):
-        # the container's sitecustomize pins the axon TPU platform and
-        # ignores the env var; honor it explicitly so CPU smoke runs work
-        import jax
+    from fei_tpu.utils.platform import honor_jax_platforms
 
-        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    # the container's sitecustomize pins the axon TPU platform and ignores
+    # the env var; honor it explicitly so CPU smoke runs work
+    honor_jax_platforms()
     if suite == "federation":
         return bench_federation(n_tokens)
     backend, devices = _touch_backend_or_reexec()

@@ -183,6 +183,12 @@ class JaxLocalProvider(Provider):
         gen_overrides: dict | None = None,
     ):
         from fei_tpu.engine import GenerationConfig, InferenceEngine
+        from fei_tpu.utils.platform import honor_jax_platforms
+
+        # the first backend touch happens below (engine construction):
+        # honor an explicit JAX_PLATFORMS despite the container's platform
+        # pin, so CPU smoke runs work and an outage is bypassable
+        honor_jax_platforms()
 
         self._GenerationConfig = GenerationConfig
         if engine is not None:

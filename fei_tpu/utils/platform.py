@@ -1,0 +1,26 @@
+"""JAX platform selection honoring ``JAX_PLATFORMS`` despite env pinning.
+
+The deployment container pins an experimental TPU platform through a
+sitecustomize hook that ignores the ``JAX_PLATFORMS`` env var; calling
+``honor_jax_platforms()`` before the first backend touch makes
+``JAX_PLATFORMS=cpu python -m fei_tpu ...`` (smoke runs, outage bypass)
+actually run on CPU. One shared implementation — bench.py and the CLI
+provider path both use it, so the workaround lives in one place.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def honor_jax_platforms() -> None:
+    """Apply the ``JAX_PLATFORMS`` env var via jax.config (idempotent).
+
+    Must run BEFORE any backend initialization (importing jax is fine —
+    backends are lazy). No env var set = default selection, untouched.
+    """
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
