@@ -1,0 +1,57 @@
+"""Long-context serving: sequence-sharded prefill + speculative decode.
+
+The agent task loop grows conversations without bound (reference
+fei/core/task_executor.py:231-252). This demo serves a ~3k-token prompt on
+an 8-device mesh: admission prefill runs ring-attention SEQUENCE-SHARDED
+(each device holds T/8 tokens — parallel/long_prefill.py routed by the
+engine), decode continues from the paged pool, and greedy echo output
+takes multi-token speculative steps verified by the multi-query block
+kernel.
+
+    python examples/long_context_serving.py   (hermetic 8-device CPU mesh)
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from fei_tpu.engine.engine import GenerationConfig, InferenceEngine
+from fei_tpu.parallel.mesh import make_mesh
+from fei_tpu.utils.metrics import METRICS
+
+
+def main() -> None:
+    mesh = make_mesh({"sp": 8})
+    engine = InferenceEngine.from_config(
+        "tiny", paged=True, batch_size=2, max_seq_len=4096,
+        mesh=mesh, long_prefill_min=1024,
+    )
+    prompt = [(13 * i + 7) % 180 + 20 for i in range(3000)]
+    gen = GenerationConfig(max_new_tokens=16, ignore_eos=True)
+
+    toks = list(engine.scheduler.stream(prompt, gen))
+    snap = METRICS.snapshot()
+    sp = snap["counters"].get("engine.sp_prefills", 0)
+    sp_s = snap["spans"].get("prefill_sp", {}).get("mean_s", 0.0)
+    spec = snap["counters"].get("scheduler.spec_steps", 0)
+    print(f"served 3000-token prompt -> {len(toks)} tokens decoded")
+    print(f"sequence-sharded prefills: {sp:.0f} (one {sp_s:.2f}s dispatch, "
+          f"each device held 3000/8 tokens via ring attention)")
+    note = (
+        "" if spec else " (random-weight output never echoed context this "
+        "run; real agent outputs echo paths/identifiers and multi-step)"
+    )
+    print(f"speculative multi-token steps: {spec:.0f}{note}")
+
+
+if __name__ == "__main__":
+    main()

@@ -559,17 +559,40 @@ class InferenceEngine:
 
     def _sp_prefill_fn(self):
         """Compiled sequence-sharded full-model prefill into a caller cache
-        (ring attention over the sp axis — parallel.long_prefill). One
-        jitted callable; jax.jit specializes per input shape."""
+        (parallel.long_prefill over the sp axis). One jitted callable;
+        jax.jit specializes per input shape. FEI_TPU_SP_ATTEND picks the
+        formulation: "ring" (default — KV blocks rotate over ppermute) or
+        "ulysses" (head↔seq all_to_all; needs heads divisible by sp, falls
+        back to ring with a log line otherwise)."""
         if self._sp_prefill_jit is None:
+            import os as _os
+
             cfg = self.cfg
             mesh = self.mesh
+            attend = _os.environ.get("FEI_TPU_SP_ATTEND", "ring").strip().lower()
+            if attend not in ("ring", "ulysses"):
+                log.warning(
+                    "unknown FEI_TPU_SP_ATTEND=%r (ring | ulysses); using ring",
+                    attend,
+                )
+                attend = "ring"
+            n = mesh.shape["sp"]
+            if attend == "ulysses" and (
+                cfg.num_heads % n or cfg.num_kv_heads % n
+            ):
+                log.warning(
+                    "FEI_TPU_SP_ATTEND=ulysses needs heads divisible by "
+                    "sp=%d (H=%d, K=%d); using ring",
+                    n, cfg.num_heads, cfg.num_kv_heads,
+                )
+                attend = "ring"
 
             def sp_prefill(params, padded, true_len, cache):
                 from fei_tpu.parallel.long_prefill import prefill_ring_kv
 
                 logits, k_all, v_all = prefill_ring_kv(
-                    params, cfg, padded, mesh, true_len=true_len
+                    params, cfg, padded, mesh, true_len=true_len,
+                    attend=attend,
                 )
                 k = jax.lax.dynamic_update_slice(
                     cache.k, k_all.astype(cache.k.dtype), (0, 0, 0, 0, 0)
