@@ -327,13 +327,19 @@ def bench_paged(model: str, n_tokens: int) -> int:
         return engine, consume, errors
 
     # see bench_decode: rebuild outside the handler so the failed engine's
-    # HBM is released before the second allocation
+    # HBM is released before the second allocation. The retry disables
+    # every optional kernel path (flash, block-attention verify, paged-
+    # native prefill) — a Mosaic rejection of any of them must never sink
+    # the bench.
     retry = False
     try:
         engine, consume, errors = build_and_warm()
     except Exception as exc:  # noqa: BLE001 — pallas must never sink the bench
-        log(f"bench: paged warm-up failed ({exc!r}); retrying FEI_TPU_FLASH=0")
+        log(f"bench: paged warm-up failed ({exc!r}); retrying with "
+            "FEI_TPU_FLASH=0 FEI_TPU_BLOCK_ATTN=0 FEI_TPU_PAGED_PREFILL=0")
         os.environ["FEI_TPU_FLASH"] = "0"
+        os.environ["FEI_TPU_BLOCK_ATTN"] = "0"
+        os.environ["FEI_TPU_PAGED_PREFILL"] = "0"
         retry = True
     if retry:
         engine, consume, errors = build_and_warm()

@@ -312,6 +312,7 @@ def write_token_kv(
     """
     ps = k_pages.shape[2]
     B = k_new.shape[0]
+    width = block_table.shape[1]
     page_slot = lengths // ps
     offset = lengths % ps
     quantized = k_scales is not None
@@ -320,7 +321,15 @@ def write_token_kv(
         vq, vs = quant_kv_rows(v_new)
         k_new, v_new = kq, vq
     for b in range(B):  # B is static and small (decode batch)
-        page = block_table[b, page_slot[b]]
+        # a position past the table's capacity (pad tokens of a final
+        # paged-prefill chunk near max_seq_len) must land in the reserved
+        # null page 0 — the gather would otherwise CLAMP to the last
+        # column, a real page, and overwrite live K/V
+        page = jnp.where(
+            page_slot[b] < width,
+            block_table[b, jnp.minimum(page_slot[b], width - 1)],
+            0,
+        )
         k_upd = k_new[b][None, :, None, :].astype(k_pages.dtype)  # [1, K, 1, D]
         v_upd = v_new[b][None, :, None, :].astype(v_pages.dtype)
         k_pages = jax.lax.dynamic_update_slice(k_pages, k_upd, (page, 0, offset[b], 0))

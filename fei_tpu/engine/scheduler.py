@@ -131,6 +131,18 @@ class PagedScheduler:
         self.spec_ngram = int(_os.environ.get("FEI_TPU_SPEC_NGRAM", "3"))
         self.spec_draft_len = int(_os.environ.get("FEI_TPU_SPEC_DRAFT", "8"))
         self.speculate = _os.environ.get("FEI_TPU_SPECULATE", "1") != "0"
+        # paged-NATIVE chunked prefill: admission chunks write K/V straight
+        # into pool pages and attend via the multi-query block kernel
+        # through a one-slot pool view — no dense staging cache (bucket ×
+        # L × K × D × 2 of HBM at 8B/8k scale), no completion scatter, and
+        # prefix-cache hits read their shared pages in place instead of
+        # gathering to dense. FEI_TPU_PAGED_PREFILL=0 restores the staging
+        # path (e.g. if Mosaic rejects the block kernel's chunk tile).
+        self.paged_native_prefill = (
+            _os.environ.get("FEI_TPU_PAGED_PREFILL", "1") != "0"
+        )
+        self._pchunk_jit: dict = {}
+        self._arm_jit = None
         self._admitting: dict | None = None  # in-flight chunked admission
         self._prefix = None  # PrefixCache when engine.prefix_cache
         self._gather_jit: dict = {}
@@ -413,7 +425,10 @@ class PagedScheduler:
                 if (
                     prefix or len(seq.prompt_ids) > self.prefill_chunk
                 ) and not sp_long:
-                    self._start_chunked(seq, slot, prefix)
+                    if self.paged_native_prefill:
+                        self._start_chunked_paged(seq, slot, prefix)
+                    else:
+                        self._start_chunked(seq, slot, prefix)
                     return  # one chunked admission at a time
                 self._admit(seq, slot)
             except BaseException as exc:  # noqa: BLE001
@@ -503,6 +518,36 @@ class PagedScheduler:
         }
         self._admit_chunk()
 
+    def _start_chunked_paged(
+        self, seq: _Seq, slot: int, prefix: list[int] | None = None
+    ) -> None:
+        """Paged-NATIVE chunked admission: each chunk forwards against a
+        one-slot view of the pool (its block-table row + running length),
+        writing K/V straight into the slot's pages and attending through
+        the multi-query block kernel — pool history INCLUDING any shared
+        prefix pages is read in place. No dense staging cache, no
+        completion scatter, no prefix gather. The slot's row in the live
+        pool stays ZERO until completion, so interleaved decode steps keep
+        writing this slot's idle token to the null page."""
+        eng = self.engine
+        alloc = eng._allocator
+        prefix = prefix or []
+        m = len(prefix)
+        ps = alloc.page_size
+        n = len(seq.prompt_ids)
+        need = alloc.pages_needed(min(n + seq.budget, eng.max_seq_len))
+        alloc.alloc(slot, need - m)
+        seq.prefilling = True
+        pages = alloc.pages_for(slot)  # prefix pages first, then fresh
+        width = self._pool.block_table.shape[1]
+        row = np.zeros((width,), dtype=np.int32)
+        row[: len(pages)] = pages
+        self._admitting = {
+            "seq": seq, "slot": slot, "mode": "paged",
+            "row": row, "pos": m * ps, "prefix": m,
+        }
+        self._admit_chunk()
+
     def _admit_chunk(self) -> None:
         """Run ONE prefill chunk of the in-flight chunked admission."""
         st = self._admitting
@@ -521,6 +566,54 @@ class PagedScheduler:
         hi = min(lo + C, n)
         toks = np.zeros((1, C), dtype=np.int32)
         toks[0, : hi - lo] = prompt[lo:hi]
+        final = hi >= n
+        if st.get("mode") == "paged":
+            try:
+                with METRICS.span("prefill_chunk", jax_trace=True):
+                    fn = self._paged_chunk_fn(C, final)
+                    out = fn(
+                        eng.params, self._pool, jnp.asarray(toks),
+                        jnp.asarray(st["row"][None]),
+                        jnp.asarray([lo], dtype=jnp.int32),
+                        jnp.int32(n - 1 - lo),
+                    )
+                    if final:
+                        last_logits, self._pool = out
+                        last_logits.block_until_ready()
+                    else:
+                        self._pool = out
+            except Exception as exc:  # noqa: BLE001
+                first = lo == st["prefix"] * eng.page_size
+                if first and self._pool_intact():
+                    # first chunk, pool untouched (e.g. Mosaic rejected the
+                    # chunk tile on-chip): release the slot and requeue the
+                    # request at the FRONT — it re-admits through the
+                    # normal path with the native route disabled, shared
+                    # prefix pages surviving on their registry refs
+                    log.warning(
+                        "paged-native prefill failed (%r); falling back to "
+                        "the dense-staging path", exc,
+                    )
+                    self.paged_native_prefill = False
+                    METRICS.incr("scheduler.paged_prefill_disabled")
+                    self._admitting = None
+                    eng._allocator.free(st["slot"])
+                    self._slots[st["slot"]] = None
+                    seq.slot = -1
+                    seq.prefilling = False
+                    seq.prefix_match = None  # pins dropped: re-probe
+                    with self._lock:
+                        self._waiting.appendleft(seq)
+                    return
+                raise
+            st["pos"] = hi
+            if not final:
+                return  # more chunks; decode steps interleave
+            self._admitting = None
+            self._complete_admission_paged(
+                seq, st["slot"], last_logits, st["row"]
+            )
+            return
         with METRICS.span("prefill_chunk", jax_trace=True):
             fn = self._chunk_fn(C, st["bucket"])
             last_logits, st["dense"] = fn(
@@ -535,6 +628,87 @@ class PagedScheduler:
             seq, st["slot"], st["dense"], st["bucket"], last_logits,
             prefix_pages=st.get("prefix", 0),
         )
+
+    def _paged_chunk_fn(self, C: int, final: bool):
+        """Compiled paged-native prefill chunk: forward [1, C] tokens
+        against a one-slot pool view (block-table row + absolute position
+        as the length), K/V landing in the slot's pages via the block
+        kernel's per-row causal writes. Pad tokens in a final partial
+        chunk write into the slot's not-yet-decoded future pages (later
+        overwritten position-by-position by decode) or — past the table's
+        capacity — into the reserved null page (write_token_kv routes
+        out-of-range positions there); either way they are never attended
+        (causal limits). Only the final chunk projects one position
+        through the LM head."""
+        key = (C, final)
+        if key not in self._pchunk_jit:
+            cfg = self.engine.cfg
+            mesh = self.engine.mesh
+            from fei_tpu.models.llama import _logits, forward_paged_block
+
+            def chunk(params, pool, toks, row, pos, last_idx):
+                view = pool._replace(block_table=row, lengths=pos)
+                hidden, view = forward_paged_block(
+                    params, cfg, toks, view, kernel_mesh=mesh, lm_head=False
+                )
+                # hand the updated pages back under the LIVE table/lengths:
+                # decode must keep seeing the zeroed row until completion
+                out_pool = view._replace(
+                    block_table=pool.block_table, lengths=pool.lengths
+                )
+                if not final:
+                    return out_pool
+                h_last = jax.lax.dynamic_slice_in_dim(
+                    hidden, last_idx, 1, axis=1
+                )  # [1, 1, H] — already final-normed (lm_head=False contract)
+                return _logits(h_last, params, cfg)[:, 0], out_pool
+
+            self._pchunk_jit[key] = jax.jit(chunk, donate_argnums=(1,))
+        return self._pchunk_jit[key]
+
+    def _arm_fn(self):
+        """Compiled slot arming: install the block-table row and the true
+        prompt length so decode starts reading the admitted pages."""
+        if self._arm_jit is None:
+
+            def arm(pool, row, slot, length):
+                bt = jax.lax.dynamic_update_slice(
+                    pool.block_table, row[None], (slot, 0)
+                )
+                ln = jax.lax.dynamic_update_slice(
+                    pool.lengths, length[None], (slot,)
+                )
+                return pool._replace(block_table=bt, lengths=ln)
+
+            self._arm_jit = jax.jit(arm, donate_argnums=(0,))
+        return self._arm_jit
+
+    def _complete_admission_paged(
+        self, seq: _Seq, slot: int, last_logits, row: np.ndarray
+    ) -> None:
+        """Admission tail for the paged-native path: sample the first
+        token, arm the slot's table row + length, register the prefix.
+        ``row`` is the block-table row the chunks wrote through (pages
+        cannot change mid-admission)."""
+        eng = self.engine
+        alloc = eng._allocator
+        n = len(seq.prompt_ids)
+        tok0, rng = self._first_token(seq, last_logits)
+        pages = alloc.pages_for(slot)
+        self._pool = self._arm_fn()(
+            self._pool, jnp.asarray(row), jnp.int32(slot),
+            jnp.asarray(n, dtype=jnp.int32),
+        )
+        self._keys = self._keys.at[slot].set(rng)
+        seq.prefilling = False
+        if self._prefix is not None:
+            self._prefix.register(
+                seq.prompt_ids, pages[: alloc.pages_needed(n)]
+            )
+        if seq.budget <= 0:
+            self._finish(seq)
+            return
+        self._deliver(seq, tok0)
 
     def _gather_fn(self, gm: int, bucket: int):
         """Compiled prefix gather: ``gm`` (power-of-two padded) cached pages
@@ -603,18 +777,10 @@ class PagedScheduler:
             self._chunk_jit[key] = jax.jit(chunk, donate_argnums=(1,))
         return self._chunk_jit[key]
 
-    def _complete_admission(
-        self, seq: _Seq, slot: int, dense, bucket: int, last_logits,
-        prefix_pages: int = 0,
-    ) -> None:
-        """Shared admission tail: sample the first token on the request's
-        own key chain (exactly like the dense single-stream prologue,
-        engine._prefill_sample), scatter the NEW prompt K/V into pages
-        (cached-prefix pages already hold theirs and are never rewritten),
-        and arm the slot for decode."""
-        eng = self.engine
-        alloc = eng._allocator
-        n = len(seq.prompt_ids)
+    def _first_token(self, seq: _Seq, last_logits) -> tuple[int, jax.Array]:
+        """Sample the admission's first token on the request's own key
+        chain (exactly like the dense single-stream prologue,
+        engine._prefill_sample), with the first-step host/grammar mask."""
         mask = self._host_mask(seq, first=True)
         if mask is None and seq.grammar is not None and seq.gstate >= 0:
             # the first token samples from prefill logits outside the step
@@ -631,6 +797,19 @@ class PagedScheduler:
                 top_k=seq.gen.top_k, top_p=seq.gen.top_p,
             )[0]
         )
+        return tok0, rng
+
+    def _complete_admission(
+        self, seq: _Seq, slot: int, dense, bucket: int, last_logits,
+        prefix_pages: int = 0,
+    ) -> None:
+        """Admission tail for the dense-staging path: sample the first
+        token, scatter the NEW prompt K/V into pages (cached-prefix pages
+        already hold theirs and are never rewritten), arm the slot."""
+        eng = self.engine
+        alloc = eng._allocator
+        n = len(seq.prompt_ids)
+        tok0, rng = self._first_token(seq, last_logits)
 
         # suffix K/V → pages + block-table row + length, pool donated
         pages = alloc.pages_for(slot)  # prefix pages first, then fresh
@@ -754,11 +933,25 @@ class PagedScheduler:
         draft = draft + [0] * (self.spec_draft_len - len(draft))
         tokens = np.zeros((self.B, T), dtype=np.int32)
         tokens[b] = [s.next_input] + draft
-        with METRICS.span("spec_step"):
-            greedy_dev, self._pool = self._spec_fn(T)(
-                eng.params, self._pool, jnp.asarray(tokens)
-            )
-            greedy = np.asarray(greedy_dev)[b]  # host sync inside the span
+        try:
+            with METRICS.span("spec_step"):
+                greedy_dev, self._pool = self._spec_fn(T)(
+                    eng.params, self._pool, jnp.asarray(tokens)
+                )
+                greedy = np.asarray(greedy_dev)[b]  # host sync in the span
+        except Exception as exc:  # noqa: BLE001
+            if self._pool_intact():
+                # compile-stage failure (e.g. Mosaic rejecting the block
+                # kernel on-chip): the donated pool was never consumed —
+                # drop to per-token steps instead of killing every stream
+                log.warning(
+                    "speculative step failed (%r); disabling speculation",
+                    exc,
+                )
+                self.speculate = False
+                METRICS.incr("scheduler.spec_disabled")
+                return False
+            raise  # pool consumed mid-execution: let _fail_all handle it
         accept = 0
         while (
             accept < self.spec_draft_len
@@ -933,6 +1126,19 @@ class PagedScheduler:
                     from fei_tpu.engine.paged_cache import PrefixCache
 
                     self._prefix = PrefixCache(self.engine._allocator)
+
+    def _pool_intact(self) -> bool:
+        """True when the donated pool's buffers were NOT consumed by a
+        failed dispatch — a compile-stage failure (the realistic on-chip
+        case: Mosaic rejecting a kernel) leaves them alive, a mid-execution
+        failure deletes them and only _fail_all can recover."""
+        try:
+            return not any(
+                getattr(leaf, "is_deleted", lambda: False)()
+                for leaf in jax.tree_util.tree_leaves(self._pool)
+            )
+        except Exception:  # noqa: BLE001 — be conservative
+            return False
 
     def _grammar_first_mask(self, seq: _Seq) -> np.ndarray:
         """Entry-state mask (with the dense path's budget-feasibility rule)

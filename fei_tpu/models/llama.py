@@ -303,8 +303,12 @@ def forward_paged_block(
     routed_moe: bool = False,
     moe_mesh=None,
     kernel_mesh=None,
+    lm_head: bool = True,
 ) -> tuple[jnp.ndarray, object]:
-    """Multi-token paged forward for speculative VERIFICATION.
+    """Multi-token paged forward for speculative VERIFICATION — and, with
+    ``lm_head=False`` (returns final-norm hidden [B, T, H] instead of
+    logits), the chunk body of paged-native prefill, which only projects
+    one position.
 
     All T tokens' projections/MLP batch into single matmuls (one weight
     read for T tokens — the point of speculation on a weight-streaming-
@@ -413,12 +417,12 @@ def forward_paged_block(
         new_ks = new_vs = None
 
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
-    logits = _logits(x, params, cfg)
+    out = _logits(x, params, cfg) if lm_head else x
     new_cache = cache._replace(
         k_pages=new_k, v_pages=new_v, lengths=cache.lengths + T,
         k_scales=new_ks, v_scales=new_vs,
     )
-    return logits, new_cache
+    return out, new_cache
 
 
 def forward_train(

@@ -136,3 +136,30 @@ class TestPagedSpeculation:
         toks = list(eng.scheduler.stream(_prompt(eng), gen))
         assert len(toks) == 8
         assert _counter("scheduler.spec_steps") == before
+
+    def test_spec_kernel_failure_disables_and_continues(self, monkeypatch):
+        """A compile-stage failure of the block-verify program must drop
+        the stream to per-token steps, not kill it."""
+        gen = GenerationConfig(max_new_tokens=16, temperature=0.0,
+                               ignore_eos=True)
+        monkeypatch.setenv("FEI_TPU_SPECULATE", "0")
+        ref = _engine()
+        want = list(ref.scheduler.stream(_prompt(ref), gen))
+
+        monkeypatch.setenv("FEI_TPU_SPECULATE", "1")
+        eng = _engine()
+        monkeypatch.setattr(
+            type(eng), "_find_draft",
+            staticmethod(lambda ids, n, d: [1, 2, 3]),
+        )
+
+        def boom(T):
+            def fn(*a, **k):
+                raise RuntimeError("Mosaic said no")
+
+            return fn
+
+        monkeypatch.setattr(eng.scheduler, "_spec_fn", boom)
+        got = list(eng.scheduler.stream(_prompt(eng), gen))
+        assert got == want
+        assert eng.scheduler.speculate is False
