@@ -489,6 +489,12 @@ class InferenceEngine:
             self._allocator = PageAllocator(num_pages, self.page_size)
         return self._pool
 
+    def close(self) -> None:
+        """Release runtime threads (the paged scheduler's device loop).
+        Idempotent; a later request restarts what it needs."""
+        if self._scheduler is not None:
+            self._scheduler.close()
+
     @property
     def scheduler(self):
         """The continuous-batching scheduler; all paged generation —

@@ -143,6 +143,7 @@ class PagedScheduler:
         )
         self._pchunk_jit: dict = {}
         self._arm_jit = None
+        self._closed = False
         self._admitting: dict | None = None  # in-flight chunked admission
         self._prefix = None  # PrefixCache when engine.prefix_cache
         self._gather_jit: dict = {}
@@ -250,6 +251,7 @@ class PagedScheduler:
                     # concurrent submit of a different grammar must see
                     # this request in flight, or it could swap the device
                     # table out from under our host DFA mirror
+                    self._closed = False  # a submit after close() reopens
                     self._waiting.append(seq)
                     self._start_thread()
                     appended = True
@@ -273,6 +275,7 @@ class PagedScheduler:
                     seq.gfallback_state = mstate
         if not appended:
             with self._lock:
+                self._closed = False  # a submit after close() reopens
                 self._waiting.append(seq)
                 self._start_thread()
         self._wake.set()
@@ -308,21 +311,65 @@ class PagedScheduler:
     # -- scheduler thread ---------------------------------------------------
 
     def _start_thread(self) -> None:
+        # callers hold self._lock, so the park-or-restart handoff with
+        # _loop's locked exit check cannot lose a submission
         if self._thread is None or not self._thread.is_alive():
+            self._closed = False
             self._thread = threading.Thread(
                 target=self._loop, name="fei-paged-scheduler", daemon=True
             )
             self._thread.start()
 
+    def close(self) -> None:
+        """Stop the device-loop thread (idempotent). In-flight requests
+        fail with EngineError; the healthy pool and prefix cache SURVIVE
+        (matching a parked-loop close) and a later submit() reopens the
+        scheduler. Joins the thread; if a long device dispatch outlives
+        the join timeout, the loop still parks itself at its next check
+        and submit()'s reopen flag keeps new requests servable."""
+        with self._lock:
+            self._closed = True
+            thread = self._thread
+        self._wake.set()
+        if thread is not None and thread.is_alive():
+            thread.join(timeout=30)
+
+    _IDLE_PARKS = 600  # ~60 s of nothing to do -> park the thread
+
     def _loop(self) -> None:
+        idle = 0
         while True:
             try:
+                if self._closed:
+                    # drain requests but KEEP the healthy pool + prefix
+                    # cache (unlike _fail_all, which handles device
+                    # failures); park under the lock so a concurrent
+                    # reopening submit either resets the flag first (we
+                    # continue) or sees a dead thread and restarts
+                    self._drain(EngineError("scheduler closed"))
+                    with self._lock:
+                        if self._closed:
+                            self._thread = None
+                            return
+                    continue
                 self._reap_cancelled()
                 self._admit_ready()
                 if not any(self._slots):
+                    if not self._waiting and self._admitting is None:
+                        idle += 1
+                        if idle > self._IDLE_PARKS:
+                            # park instead of polling forever: every live
+                            # engine otherwise keeps a 10 Hz daemon thread
+                            # for its whole lifetime (test suites stack
+                            # dozens). submit() restarts the loop.
+                            with self._lock:
+                                if not self._waiting and not any(self._slots):
+                                    self._thread = None
+                                    return
                     self._wake.wait(timeout=0.1)
                     self._wake.clear()
                     continue
+                idle = 0
                 self._step_active()
             except BaseException as exc:  # noqa: BLE001
                 log.error("scheduler loop error: %r", exc)
@@ -468,12 +515,9 @@ class PagedScheduler:
         eng = self.engine
         alloc = eng._allocator
         prefix = prefix or []
-        m = len(prefix)
+        m = self._reserve_admission(seq, slot, prefix)
         ps = alloc.page_size
         n = len(seq.prompt_ids)
-        need = alloc.pages_needed(min(n + seq.budget, eng.max_seq_len))
-        alloc.alloc(slot, need - m)
-        seq.prefilling = True
         from fei_tpu.engine.engine import _next_bucket
 
         # the bucket MUST fit every full chunk write: chunks write C-row
@@ -518,6 +562,31 @@ class PagedScheduler:
         }
         self._admit_chunk()
 
+    def _reserve_admission(
+        self, seq: _Seq, slot: int, prefix: list[int]
+    ) -> int:
+        """Shared admission prologue: reserve the slot's fresh pages
+        (shared prefix pages were already handed over) and mark it
+        prefilling. Returns the prefix page count. One implementation so
+        the staging and paged-native paths can never diverge on the page
+        budget."""
+        eng = self.engine
+        alloc = eng._allocator
+        m = len(prefix)
+        n = len(seq.prompt_ids)
+        need = alloc.pages_needed(min(n + seq.budget, eng.max_seq_len))
+        alloc.alloc(slot, need - m)
+        seq.prefilling = True
+        return m
+
+    def _slot_row(self, slot: int) -> np.ndarray:
+        """The slot's padded block-table row (null-page padded)."""
+        from fei_tpu.engine.paged_cache import build_block_table
+
+        width = self._pool.block_table.shape[1]
+        pages = self.engine._allocator.pages_for(slot)
+        return np.asarray(build_block_table([pages], width))[0]
+
     def _start_chunked_paged(
         self, seq: _Seq, slot: int, prefix: list[int] | None = None
     ) -> None:
@@ -529,22 +598,12 @@ class PagedScheduler:
         completion scatter, no prefix gather. The slot's row in the live
         pool stays ZERO until completion, so interleaved decode steps keep
         writing this slot's idle token to the null page."""
-        eng = self.engine
-        alloc = eng._allocator
         prefix = prefix or []
-        m = len(prefix)
-        ps = alloc.page_size
-        n = len(seq.prompt_ids)
-        need = alloc.pages_needed(min(n + seq.budget, eng.max_seq_len))
-        alloc.alloc(slot, need - m)
-        seq.prefilling = True
-        pages = alloc.pages_for(slot)  # prefix pages first, then fresh
-        width = self._pool.block_table.shape[1]
-        row = np.zeros((width,), dtype=np.int32)
-        row[: len(pages)] = pages
+        m = self._reserve_admission(seq, slot, prefix)
         self._admitting = {
             "seq": seq, "slot": slot, "mode": "paged",
-            "row": row, "pos": m * ps, "prefix": m,
+            "row": self._slot_row(slot),
+            "pos": m * self.engine.page_size, "prefix": m,
         }
         self._admit_chunk()
 
@@ -815,9 +874,7 @@ class PagedScheduler:
         pages = alloc.pages_for(slot)  # prefix pages first, then fresh
         n_prompt_pages = alloc.pages_needed(n)
         write_pages = pages[prefix_pages:n_prompt_pages]
-        width = self._pool.block_table.shape[1]
-        row = np.zeros((width,), dtype=np.int32)
-        row[: len(pages)] = pages
+        row = self._slot_row(slot)
         start = prefix_pages * alloc.page_size
         admit_fn = self._admit_fn(bucket, len(write_pages))
         self._pool = admit_fn(
@@ -1089,6 +1146,22 @@ class PagedScheduler:
             self.engine._allocator.free(slot)
             self._slots[slot] = None
         seq.out.put(_DONE)
+
+    def _drain(self, exc: BaseException) -> None:
+        """Fail every queued and in-flight request WITHOUT dropping device
+        state — the pool is healthy (close/drain case), so slots evict
+        normally and the prefix cache keeps its entries."""
+        with self._lock:
+            waiting = list(self._waiting)
+            self._waiting.clear()
+        for s in waiting:
+            s.finished = True
+            s.out.put(exc)
+        self._admitting = None
+        for s in list(self._slots):
+            if s is not None:
+                s.out.put(exc)
+                self._finish(s)
 
     def _fail_all(self, exc: BaseException) -> None:
         """A device failure mid-step leaves the donated pool unusable: drop

@@ -171,3 +171,34 @@ class TestPagedNativePrefill:
         second = list(native.scheduler.stream(PROMPT, GEN))  # prefix hit
         assert second == w2
         assert native.scheduler.paged_native_prefill is False
+
+
+class TestSchedulerLifecycle:
+    def test_idle_park_and_restart(self, monkeypatch):
+        import time
+
+        eng = _engine(monkeypatch, native=True)
+        gen = GenerationConfig(max_new_tokens=4, ignore_eos=True)
+        a = list(eng.scheduler.stream(list(range(20, 40)), gen))
+        sched = eng.scheduler
+        sched._IDLE_PARKS = 3  # park after ~0.3 s idle
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            t = sched._thread
+            if t is None or not t.is_alive():
+                break
+            time.sleep(0.1)
+        t = sched._thread
+        assert t is None or not t.is_alive(), "loop never parked"
+        # a new request restarts the loop transparently
+        b = list(eng.scheduler.stream(list(range(20, 40)), gen))
+        assert b == a
+
+    def test_close_fails_inflight_and_restarts(self, monkeypatch):
+        eng = _engine(monkeypatch, native=True)
+        gen = GenerationConfig(max_new_tokens=4, ignore_eos=True)
+        list(eng.scheduler.stream(list(range(20, 40)), gen))
+        eng.close()
+        # closed loop drains; a later submit restarts it
+        got = list(eng.scheduler.stream(list(range(20, 40)), gen))
+        assert len(got) == 4

@@ -114,6 +114,17 @@ class TestStreamedLoadRss:
                 f"RSS not attributable here (eager load grew only "
                 f"{eager['delta']/1e9:.2f} GB for {pbytes/1e9:.2f} GB)"
             )
+        # the shards must actually be resident: near-zero streamed growth
+        # with a NORMAL eager measurement is the lazy/mmap-regression
+        # signature — but retry once first, since memory-pressure bursts
+        # can depress a single subprocess's watermark
+        if streamed["delta"] < 0.8 * pbytes:
+            streamed = run("streamed")
+        assert streamed["delta"] > 0.8 * pbytes, (
+            f"streamed load grew RSS by only {streamed['delta']/1e9:.2f} GB "
+            f"for {pbytes/1e9:.2f} GB of params (eager measured normally) — "
+            "nothing materialized?"
+        )
         # budget: final resident shards + bounded per-slice staging.
         # Measured 1.24-1.27x across runs; the eager path (whole stacked
         # tensors staged on host one at a time) measures 1.44x, so 1.35
