@@ -531,20 +531,22 @@ class RemoteProvider(Provider):
                     f"remote endpoint error: {body['error']}"
                 )
             msg = body["choices"][0]["message"]
+            calls = [
+                ToolCall(
+                    tc.get("id", f"call_{uuid.uuid4().hex[:12]}"),
+                    tc["function"]["name"],
+                    json.loads(tc["function"].get("arguments") or "{}"),
+                )
+                for tc in (msg.get("tool_calls") or [])
+            ]
         except ProviderError:
             raise
         except Exception as exc:  # noqa: BLE001
+            # covers transport errors AND malformed 200s (missing fields,
+            # invalid tool-call argument JSON) — one error contract
             raise ProviderError(
                 f"remote completion failed: {exc}", cause=exc
             ) from exc
-        calls = [
-            ToolCall(
-                tc.get("id", f"call_{uuid.uuid4().hex[:12]}"),
-                tc["function"]["name"],
-                json.loads(tc["function"].get("arguments") or "{}"),
-            )
-            for tc in (msg.get("tool_calls") or [])
-        ]
         return ProviderResponse(
             content=msg.get("content") or "",
             tool_calls=calls,

@@ -452,17 +452,27 @@ class TokenGrammar:
         on-device constrained decode scan (engine.generate_constrained):
         mask = table[state] >= 0, state' = table[state, token] — no host
         round-trip per token. Columns pad with -1 up to ``vocab_size`` (the
-        model's tile-rounded vocab can exceed the tokenizer's)."""
+        model's tile-rounded vocab can exceed the tokenizer's).
+
+        Memoized per vocab_size: a multi-tool union table at 128k vocab is
+        tens of MB — re-uploading it every agent turn would sit on the
+        per-turn latency path. The device arrays live as long as this
+        TokenGrammar (the provider memoizes one per tool set)."""
         import jax.numpy as jnp
 
-        table = self.table
-        if vocab_size is not None and vocab_size > table.shape[1]:
-            pad = np.full(
-                (table.shape[0], vocab_size - table.shape[1]), -1,
-                dtype=table.dtype,
-            )
-            table = np.concatenate([table, pad], axis=1)
-        return jnp.asarray(table), jnp.asarray(self.min_dist)
+        cache = getattr(self, "_dev_tables", None)
+        if cache is None:
+            cache = self._dev_tables = {}
+        if vocab_size not in cache:
+            table = self.table
+            if vocab_size is not None and vocab_size > table.shape[1]:
+                pad = np.full(
+                    (table.shape[0], vocab_size - table.shape[1]), -1,
+                    dtype=table.dtype,
+                )
+                table = np.concatenate([table, pad], axis=1)
+            cache[vocab_size] = (jnp.asarray(table), jnp.asarray(self.min_dist))
+        return cache[vocab_size]
 
     def walk(self, token_ids: list[int]) -> int:
         """State after consuming ``token_ids`` from entry; -1 if rejected."""

@@ -330,6 +330,10 @@ class PagedScheduler:
         with self._lock:
             self._closed = True
             thread = self._thread
+            # release the installed grammar refs (the device tables are
+            # memoized on the TokenGrammar itself, so a reopen re-installs
+            # without a fresh upload)
+            self._ggrammar = self._gtable = self._gmind = None
         self._wake.set()
         if thread is not None and thread.is_alive():
             thread.join(timeout=30)
@@ -941,6 +945,16 @@ class PagedScheduler:
         if emit:
             seq.generated.append(t)
             seq.out.put(t)
+        if not done and seq.gfallback_state is not None:
+            # host-mask tool-call fallback: advance the masker NOW (it is
+            # idempotent per prefix length) so acceptance ends the turn at
+            # the completing token — matching the device-native path —
+            # instead of burning the budget on stop tokens when
+            # ignore_eos leaves seq.stops empty
+            seq.mask_fn(seq.generated)
+            if seq.gfallback_state.get("accepted"):
+                seq.gaccepted = True
+                done = True
         if done:
             self._finish(seq)
             return
@@ -971,7 +985,11 @@ class PagedScheduler:
             s.prefilling
             or s.gen.temperature != 0.0
             or s.mask_fn is not None
-            or s.grammar is not None
+            # device-grammar requests speculate during their FREE phase
+            # (pre-trigger — the bulk of an agent turn); once the DFA
+            # engages (gstate >= 0) verification can't apply the mask,
+            # so constrained decode keeps per-token steps
+            or (s.grammar is not None and s.gstate >= 0)
         ):
             return False
         eng = self.engine
@@ -1016,18 +1034,29 @@ class PagedScheduler:
         ):
             accept += 1
         # greedy[:accept + 1] are all model-chosen tokens (verified draft
-        # prefix + the bonus token). KV is real through L0 + accept; the
-        # block wrote T rows, so shrink the slot's length — inactive slots'
-        # lengths return to 0 (their writes landed in the null page)
-        lengths = np.zeros((self.B,), dtype=np.int32)
-        lengths[b] = L0 + accept + 1
-        self._pool = self._pool._replace(lengths=jnp.asarray(lengths))
+        # prefix + the bonus token)
         METRICS.incr("scheduler.spec_steps")
         METRICS.incr("scheduler.spec_accepted", accept)
+        delivered = 0
         for t in [int(g) for g in greedy[: accept + 1]]:
             self._deliver(s, t)
             if s.finished:
                 break
+            delivered += 1
+            if s.grammar is not None and s.gstate >= 0:
+                # the tool-call trigger completed inside this block: the
+                # remaining verified tokens were sampled UNCONSTRAINED —
+                # drop them; the constrained phase re-decodes under the
+                # DFA mask from here
+                break
+        if not s.finished:
+            # KV is real through L0 + delivered - 1; the next fed token is
+            # s.next_input at position L0 + delivered. The block wrote T
+            # rows, so shrink the slot's length — inactive slots' lengths
+            # return to 0 (their writes landed in the null page)
+            lengths = np.zeros((self.B,), dtype=np.int32)
+            lengths[b] = L0 + delivered
+            self._pool = self._pool._replace(lengths=jnp.asarray(lengths))
         return True
 
     def _spec_fn(self, T: int):

@@ -163,3 +163,104 @@ class TestPagedSpeculation:
         got = list(eng.scheduler.stream(_prompt(eng), gen))
         assert got == want
         assert eng.scheduler.speculate is False
+
+    def test_grammar_free_phase_speculates(self, monkeypatch):
+        """Device-grammar requests speculate while WATCHING for the
+        trigger (the bulk of an agent turn) and stay token-identical."""
+        from fei_tpu.engine.grammar import compile_agent_tool_grammar
+
+        tools = [{
+            "name": "LS", "description": "d",
+            "input_schema": {
+                "type": "object",
+                "properties": {"p": {"type": "string"}},
+                "required": ["p"],
+            },
+        }]
+        gen = GenerationConfig(max_new_tokens=24, temperature=0.0,
+                               ignore_eos=True)
+        never = "\x07NEVER\x07"  # trigger that cannot occur: whole turn free
+
+        monkeypatch.setenv("FEI_TPU_SPECULATE", "0")
+        ref = _engine()
+        g_ref = compile_agent_tool_grammar(tools, ref.tokenizer)
+        want = list(ref.generate_stream_toolcalls(
+            _prompt(ref), gen, grammar=g_ref, trigger=never
+        ))
+
+        monkeypatch.setenv("FEI_TPU_SPECULATE", "1")
+        eng = _engine()
+        g = compile_agent_tool_grammar(tools, eng.tokenizer)
+        n_prompt = len(_prompt(eng))
+
+        def oracle_draft(ids, ngram, draft_len):
+            done = len(ids) - n_prompt
+            return list(want[done:done + draft_len]) or None
+
+        monkeypatch.setattr(
+            type(eng), "_find_draft", staticmethod(oracle_draft)
+        )
+        s0 = _counter("scheduler.spec_steps")
+        got = list(eng.generate_stream_toolcalls(
+            _prompt(eng), gen, grammar=g, trigger=never
+        ))
+        assert got == want
+        assert _counter("scheduler.spec_steps") > s0, (
+            "free phase of a grammar request never speculated"
+        )
+
+    def test_trigger_mid_spec_block_engages_grammar(self, monkeypatch):
+        """When the trigger completes inside a verified block, the
+        remaining unconstrained block tokens are dropped and the DFA takes
+        over — the emitted call must still be valid."""
+        import json as _json
+
+        from fei_tpu.engine.grammar import char_walk, compile_agent_tool_grammar
+
+        tools = [{
+            "name": "LS", "description": "d",
+            "input_schema": {
+                "type": "object",
+                "properties": {"p": {"type": "string"}},
+                "required": ["p"],
+            },
+        }]
+        gen = GenerationConfig(max_new_tokens=96, temperature=0.0,
+                               ignore_eos=True)
+        monkeypatch.setenv("FEI_TPU_SPECULATE", "1")
+        eng = _engine()
+        g = compile_agent_tool_grammar(tools, eng.tokenizer)
+        # unconstrained prefix of this engine's own output; pick the first
+        # position whose cumulative decode is non-empty text (leading
+        # special tokens decode to nothing)
+        free = list(eng.scheduler.stream(
+            _prompt(eng), GenerationConfig(max_new_tokens=24, ignore_eos=True)
+        ))
+        trigger = ""
+        for k in range(2, len(free) + 1):
+            trigger = eng.tokenizer.decode(free[:k])
+            if trigger:
+                break
+        if not trigger:
+            pytest.skip("model output decodes entirely empty")
+
+        def eager_draft(ids, ngram, draft_len):
+            # always propose the free continuation so a spec block is in
+            # flight when the trigger completes
+            done = len(ids) - len(_prompt(eng))
+            return list(free[done:done + draft_len]) or [free[0]]
+
+        monkeypatch.setattr(
+            type(eng), "_find_draft", staticmethod(eager_draft)
+        )
+        toks = list(eng.generate_stream_toolcalls(
+            _prompt(eng), gen, grammar=g, trigger=trigger
+        ))
+        text = eng.tokenizer.decode(toks)
+        if trigger in text and text.endswith("</tool_call>"):
+            payload = text.split(trigger, 1)[1][: -len("</tool_call>")]
+            obj = _json.loads(payload)
+            assert obj["name"] == "LS"
+            assert char_walk(g, payload) == g.accept
+        else:
+            assert "</tool_call>" not in text

@@ -153,3 +153,36 @@ class TestPagedConstrainedNative:
             assert obj["name"] in {t["name"] for t in TOOLS}
         else:
             assert "</tool_call>" not in text
+
+
+class TestToolcallFallbackTermination:
+    def test_fallback_toolcall_ends_at_acceptance(self):
+        """A host-mask fallback tool-call request (second distinct grammar
+        in flight) must end its turn at DFA acceptance like the native
+        path — not burn the remaining budget on stop tokens when
+        ignore_eos leaves the stop set empty."""
+        paged = InferenceEngine.from_config("tiny", paged=True, batch_size=2)
+        g1 = compile_agent_tool_grammar(TOOLS[:1], paged.tokenizer)
+        g2 = compile_agent_tool_grammar(TOOLS[1:], paged.tokenizer)
+        gen = GenerationConfig(max_new_tokens=200, ignore_eos=True)
+        sched = paged.scheduler
+        # g1 native and still in flight while g2 submits -> g2 falls back
+        sa = sched.submit(list(range(7, 15)), gen, grammar=g1)
+        sb = sched.submit(
+            list(range(9, 17)), gen, grammar=g2, grammar_trigger="",
+        )
+        assert sb.grammar is None and sb.mask_fn is not None
+        a = list(sched.drain(sa))
+        b = list(sched.drain(sb))
+        # empty trigger engages the masker at the first walkable token
+        # (free-phase noise may precede the call); acceptance must END the
+        # stream well before the 200-token budget, with a complete valid
+        # call as the tail
+        text = paged.tokenizer.decode(b)
+        assert sb.gaccepted, text
+        assert len(b) < 120, (len(b), text)
+        assert any(
+            char_walk(g2, text[i:]) == g2.accept
+            for i, ch in enumerate(text) if ch == "{"
+        ), text
+        assert char_walk(g1, paged.tokenizer.decode(a)) == g1.accept
