@@ -14,7 +14,7 @@ import pytest
 
 from fei_tpu.engine.engine import GenerationConfig, InferenceEngine
 
-PROMPT = [(7 * i + 11) % 200 + 10 for i in range(700)]  # ~3 chunks of 256
+PROMPT = [(7 * i + 11) % 200 + 10 for i in range(560)]  # 2 chunks + partial
 GEN = GenerationConfig(max_new_tokens=12, ignore_eos=True)
 
 
