@@ -375,25 +375,44 @@ class InferenceEngine:
             gstate = jnp.asarray([grammar.walk([first])], dtype=jnp.int32)
             remaining = jnp.asarray(budget - 1, dtype=jnp.int32)
             token = tok.reshape(1, 1)
-            left = budget - 1
-            while left > 0 and slots_left > 0:
-                n = chunk if slots_left >= chunk else slots_left
-                fused = self._grammar_fused_fn(gen, n)
-                toks, cache, token, rng, gstate, remaining = fused(
-                    self.params, cache, token, rng, gstate, remaining,
-                    table, min_dist,
-                )
-                host = np.asarray(toks)[0, :].tolist()
-                slots_left -= n
-                stopped = False
-                for t in host[: min(n, left)]:
-                    if t in stops:
-                        stopped = True
-                        break
-                    out.append(t)
-                if stopped:
+            # software-pipelined chunk loop: chunk k+1 is dispatched BEFORE
+            # chunk k's tokens come back for the host stop-check — every
+            # input of the fused step lives on device, so the fetch
+            # round-trip (~75 ms over the tunneled backend) overlaps the
+            # next chunk's compute. On a stop the in-flight chunk is simply
+            # abandoned (bounded waste: <=chunk tokens into a cache that
+            # dies with this call; DFA state stays correct because the
+            # speculative chunk continues from the post-k device state).
+            want = budget - 1  # max tokens still to emit after `first`
+            sched = 0  # tokens dispatched beyond `first`
+            pending: tuple | None = None
+            stopped = False
+            while True:
+                nxt: tuple | None = None
+                if not stopped and sched < want and slots_left > 0:
+                    n = chunk if slots_left >= chunk else slots_left
+                    fused = self._grammar_fused_fn(gen, n)
+                    toks, cache, token, rng, gstate, remaining = fused(
+                        self.params, cache, token, rng, gstate, remaining,
+                        table, min_dist,
+                    )
+                    slots_left -= n
+                    sched += n
+                    nxt = (toks, n)
+                if pending is None and nxt is None:
                     break
-                left -= n
+                if pending is not None:
+                    toks_p, n_p = pending
+                    host = np.asarray(toks_p)[0, :].tolist()
+                    emit = min(n_p, want - (len(out) - 1))
+                    for t in host[:emit]:
+                        if t in stops:
+                            stopped = True
+                            break
+                        out.append(t)
+                    if stopped:
+                        break
+                pending = nxt
         total = time.perf_counter() - t0
         return self._make_result(out, len(prompt_ids), ttft, total)
 

@@ -30,11 +30,6 @@ from fei_tpu.ops.quant import mm, quantize as _quantize_w
 from fei_tpu.ops.rmsnorm import rms_norm
 from fei_tpu.ops.rope import apply_rope, compute_rope_freqs
 
-# one jitted quantizer shared by every init_params call: fuses the
-# fp32-upcast/round/clip into one kernel, compile-cached per weight shape
-_q8 = jax.jit(_quantize_w)
-
-
 class KVCache(NamedTuple):
     """Static-shape KV cache. k/v: [L, B, S, K, D]; length: [B] valid prefix."""
 
@@ -52,57 +47,83 @@ class KVCache(NamedTuple):
         )
 
 
+_INIT_BUILDERS: dict = {}  # (repr(cfg), str(dtype), quantize) -> jitted builder
+
+
 def init_params(
     cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16, quantize: str | None = None
 ) -> dict:
     """Random-init parameter pytree (layers stacked on axis 0).
 
-    ``quantize="int8"`` quantizes each big linear as it is created, so peak
-    device memory is one bf16 tensor plus its int8 copy — not the whole
-    bf16 model (an 8B random-init would otherwise need ~16 GB before
-    quantization could run)."""
+    The whole tree is built inside ONE jitted program: each eager dispatch
+    pays a compile + RPC round-trip (over the tunneled axon TPU backend
+    these run ~30-60 s apiece, so per-tensor init of an 8B took >20 min),
+    while one compiled program materializes every tensor on device in
+    seconds. ``quantize="int8"`` quantizes each big linear inline, and an
+    ``optimization_barrier`` chain threads each tensor's key through the
+    previous tensor so XLA cannot materialize several bf16 sources at once
+    — peak memory stays near one source tensor plus the finished outputs
+    (an 8B random-init would otherwise risk ~16 GB of simultaneous bf16
+    before the quantize consumers run). Builders are cached per
+    (config, dtype, quantize) so repeated inits hit the compile cache."""
+    cache_key = (repr(cfg), str(dtype), quantize)
+    built = _INIT_BUILDERS.get(cache_key)
+    if built is not None:
+        return built(key)
+
     h, d = cfg.hidden_size, cfg.head_dim_
     H, K, I, L = cfg.num_heads, cfg.num_kv_heads, cfg.intermediate_size, cfg.num_layers
-    keys = iter(jax.random.split(key, 16))
 
-    def init(k, shape, fan_in, quant=False):
-        w = (
-            jax.random.normal(k, shape, dtype=jnp.float32) * (fan_in ** -0.5)
-        ).astype(dtype)
-        if quant and quantize == "int8":
-            return _q8(w)
-        return w
+    def _build(key):
+        keys = iter(jax.random.split(key, 16))
+        prev = None  # barrier chain: orders tensor materialization
 
-    layers: dict = {
-        "attn_norm": jnp.ones((L, h), dtype=dtype),
-        "wq": init(next(keys), (L, h, H * d), h, quant=True),
-        "wk": init(next(keys), (L, h, K * d), h, quant=True),
-        "wv": init(next(keys), (L, h, K * d), h, quant=True),
-        "wo": init(next(keys), (L, H * d, h), H * d, quant=True),
-        "mlp_norm": jnp.ones((L, h), dtype=dtype),
-    }
-    if cfg.is_moe:
-        E = cfg.num_experts
-        layers.update(
-            router=init(next(keys), (L, h, E), h),
-            w_gate=init(next(keys), (L, E, h, I), h, quant=True),
-            w_up=init(next(keys), (L, E, h, I), h, quant=True),
-            w_down=init(next(keys), (L, E, I, h), I, quant=True),
-        )
-    else:
-        layers.update(
-            w_gate=init(next(keys), (L, h, I), h, quant=True),
-            w_up=init(next(keys), (L, h, I), h, quant=True),
-            w_down=init(next(keys), (L, I, h), I, quant=True),
-        )
-    params = {
-        "embed": init(next(keys), (cfg.vocab_size, h), h),
-        "layers": layers,
-        "final_norm": jnp.ones((h,), dtype=dtype),
-    }
-    if not cfg.tie_embeddings:
-        params["lm_head"] = init(next(keys), (h, cfg.vocab_size), h, quant=True)
-    return params
+        def init(k, shape, fan_in, quant=False):
+            nonlocal prev
+            if prev is not None:
+                k, _ = jax.lax.optimization_barrier((k, prev))
+            w = (
+                jax.random.normal(k, shape, dtype=jnp.float32) * (fan_in ** -0.5)
+            ).astype(dtype)
+            if quant and quantize == "int8":
+                w = _quantize_w(w)
+            prev = w.q if hasattr(w, "q") else w
+            return w
+
+        layers: dict = {
+            "attn_norm": jnp.ones((L, h), dtype=dtype),
+            "wq": init(next(keys), (L, h, H * d), h, quant=True),
+            "wk": init(next(keys), (L, h, K * d), h, quant=True),
+            "wv": init(next(keys), (L, h, K * d), h, quant=True),
+            "wo": init(next(keys), (L, H * d, h), H * d, quant=True),
+            "mlp_norm": jnp.ones((L, h), dtype=dtype),
+        }
+        if cfg.is_moe:
+            E = cfg.num_experts
+            layers.update(
+                router=init(next(keys), (L, h, E), h),
+                w_gate=init(next(keys), (L, E, h, I), h, quant=True),
+                w_up=init(next(keys), (L, E, h, I), h, quant=True),
+                w_down=init(next(keys), (L, E, I, h), I, quant=True),
+            )
+        else:
+            layers.update(
+                w_gate=init(next(keys), (L, h, I), h, quant=True),
+                w_up=init(next(keys), (L, h, I), h, quant=True),
+                w_down=init(next(keys), (L, I, h), I, quant=True),
+            )
+        params = {
+            "embed": init(next(keys), (cfg.vocab_size, h), h),
+            "layers": layers,
+            "final_norm": jnp.ones((h,), dtype=dtype),
+        }
+        if not cfg.tie_embeddings:
+            params["lm_head"] = init(next(keys), (h, cfg.vocab_size), h, quant=True)
+        return params
+
+    built = jax.jit(_build)
+    _INIT_BUILDERS[cache_key] = built
+    return built(key)
 
 
 _FLASH_MIN_T = 64  # below this, kernel launch overhead beats the fusion win

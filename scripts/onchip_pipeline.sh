@@ -1,0 +1,48 @@
+#!/bin/bash
+# On-chip validation pipeline: run when the axon TPU backend is attachable.
+# Stages log to $OUT/<stage>.log (default /tmp/onchip); stages are never
+# killed from outside — a client killed mid-claim wedges the chip lease
+# (see .claude/skills/verify/SKILL.md gotchas).
+#
+# Covers VERDICT r2 items 1-2: the 8B int8 gate bench plus Mosaic
+# validation of every kernel added while the chip was down (flash backward,
+# int8-KV decode, multi-query ragged verification, paged/moe suites).
+set -u
+OUT="${OUT:-/tmp/onchip}"
+mkdir -p "$OUT"
+cd /root/repo
+echo "=== pipeline start $(date -u) ===" >> "$OUT/pipeline.log"
+
+stage() {
+  local name="$1"; shift
+  echo "[$(date -u +%H:%M:%S)] stage $name start" >> "$OUT/pipeline.log"
+  "$@" > "$OUT/$name.log" 2>&1
+  local rc=$?  # capture BEFORE echo: $(date) in the echo word resets $?
+  echo "[$(date -u +%H:%M:%S)] stage $name rc=$rc" >> "$OUT/pipeline.log"
+}
+
+# 1. THE GATE: 8B int8 decode bench (the driver's default metric)
+stage bench_8b_int8 env FEI_TPU_BENCH_MAX_WAIT_S=300 python -u bench.py
+
+# 2. Mosaic kernel validation (flash fwd/bwd, paged, int8-KV, mq-ragged)
+stage kernels env FEI_TPU_TEST_PLATFORM=tpu python -m pytest \
+  tests/test_pallas_kernels.py tests/test_kv_quant.py -q
+
+# 3. flash-attention backward on-chip (jax.grad through the pallas kernels)
+stage flash_grad env FEI_TPU_TEST_PLATFORM=tpu python -m pytest \
+  tests/test_flash_in_model.py -q
+
+# 4. paged serving aggregate throughput (BASELINE config #3 shape)
+stage bench_paged env FEI_TPU_BENCH_SUITE=paged FEI_TPU_BENCH_MAX_WAIT_S=300 \
+  python -u bench.py
+
+# 5. routed-MoE decode (BASELINE config #4 proxy)
+stage bench_moe env FEI_TPU_BENCH_SUITE=moe FEI_TPU_BENCH_MAX_WAIT_S=300 \
+  python -u bench.py
+
+# 6. int8-KV paged decode variant
+stage bench_paged_kv8 env FEI_TPU_BENCH_SUITE=paged FEI_TPU_BENCH_KV_QUANT=int8 \
+  FEI_TPU_BENCH_MAX_WAIT_S=300 python -u bench.py
+
+echo "=== pipeline done $(date -u) ===" >> "$OUT/pipeline.log"
+touch "$OUT/DONE"

@@ -1,0 +1,22 @@
+#!/bin/bash
+# Recovery watcher for the tunneled axon TPU backend. Probes attach in a
+# loop; when one succeeds, runs scripts/onchip_pipeline.sh once and exits.
+#
+# Probes are never killed: a client killed mid-claim wedges the chip lease
+# and every subsequent attach hangs until the lease expires. A down backend
+# fails fast with UNAVAILABLE; a wedged lease hangs-then-fails; both loop.
+# Launch detached:  nohup bash scripts/tpu_watcher.sh >/dev/null 2>&1 &
+set -u
+LOG="${LOG:-/tmp/tpu_watch.log}"
+echo "watcher start $(date -u)" >> "$LOG"
+while true; do
+  t0=$(date +%s)
+  if python -c "import jax; jax.devices()" >> "$LOG" 2>&1; then
+    echo "ATTACH OK $(date -u) (probe took $(( $(date +%s) - t0 ))s)" >> "$LOG"
+    bash "$(dirname "$0")/onchip_pipeline.sh"
+    echo "pipeline finished $(date -u)" >> "$LOG"
+    exit 0
+  fi
+  echo "probe failed $(date -u) (took $(( $(date +%s) - t0 ))s); sleeping 120s" >> "$LOG"
+  sleep 120
+done
