@@ -141,6 +141,16 @@ class PagedScheduler:
         self.paged_native_prefill = (
             _os.environ.get("FEI_TPU_PAGED_PREFILL", "1") != "0"
         )
+        # multi-step decode: scan up to N batched steps inside ONE device
+        # dispatch when nothing needs the host between steps (no pending
+        # admission, no host masks, no grammar trigger-watching). The
+        # per-step host round-trip otherwise bounds aggregate throughput
+        # (over the tunneled backend it IS the step time); the cost is up
+        # to N steps of extra admission latency for a request that arrives
+        # mid-dispatch. FEI_TPU_SCHED_MULTISTEP=1 disables.
+        self.multistep = max(
+            1, int(_os.environ.get("FEI_TPU_SCHED_MULTISTEP", "8"))
+        )
         self._pchunk_jit: dict = {}
         self._arm_jit = None
         self._closed = False
@@ -1081,6 +1091,8 @@ class PagedScheduler:
         B, V = self.B, eng.cfg.vocab_size
         if self._maybe_spec_step():
             return
+        if self._try_multi_step():
+            return
         # evaluate per-request masks FIRST: a user mask_fn that raises (or
         # returns an over-wide mask) must kill only its own request, never
         # the other in-flight sequences or the pool
@@ -1098,39 +1110,99 @@ class PagedScheduler:
                 masks[b] = m
         # decode only runs for armed slots; chunk-prefilling slots write to
         # the null page (their table row is still zeroed) and are skipped
-        if not any(s is not None and not s.prefilling for s in self._slots):
+        active = [
+            (b, s) for b, s in enumerate(self._slots)
+            if s is not None and not s.prefilling
+        ]
+        if not active:
             return
 
+        masked = bool(masks)
+        mask = None
+        if masked:
+            mask = np.ones((B, V), dtype=bool)
+            for b, m in masks.items():
+                mask[b] = m
+            # every host-evaluated mask pays a [B, V] upload — the metric
+            # the device-native grammar path is measured against
+            METRICS.incr("scheduler.host_mask_uploads", len(masks))
+        toks = self._dispatch_steps(active, 1, mask=mask)
+        for b, s in active:
+            # defensive symmetry with the multi-step loop; with n=1 nothing
+            # can replace a slot between assembly and delivery
+            if self._slots[b] is not s:
+                continue
+            self._deliver(s, int(toks[b, 0]))
+
+    def _try_multi_step(self) -> bool:
+        """Run up to ``self.multistep`` decode steps in ONE device dispatch.
+
+        Eligible only when the host has nothing to do between steps: no
+        queued or in-flight admission, every armed slot maskless and not
+        in a grammar free phase (the trigger scanner must see each token
+        as it streams), and every slot has >= N budget left — so tokens
+        decoded past a mid-scan stop stay inside the slot's reserved
+        pages (they are never delivered, and prefix-cache registration
+        only covers delivered tokens, so garbage positions are
+        unreachable). Constrained slots are fine: the scan advances their
+        DFA states on device exactly like the dense fused path."""
+        cap = self.multistep
+        if cap <= 1 or self._waiting or self._admitting is not None:
+            return False
+        active = [(b, s) for b, s in enumerate(self._slots) if s is not None]
+        if not active:
+            return False
+        for _, s in active:
+            if s.prefilling or s.mask_fn is not None:
+                return False
+            if s.grammar is not None and s.gstate < 0:
+                return False
+        headroom = min(s.budget - len(s.generated) for _, s in active)
+        n = 1
+        while n * 2 <= min(cap, headroom):
+            n *= 2
+        if n <= 1:
+            return False
+
+        toks = self._dispatch_steps(active, n)
+        METRICS.incr("scheduler.multi_steps")
+        METRICS.incr("scheduler.multi_tokens", n)
+        for i in range(n):
+            for b, s in active:
+                if self._slots[b] is not s:  # finished at an earlier step
+                    continue
+                self._deliver(s, int(toks[b, i]))
+        return True
+
+    def _dispatch_steps(
+        self, active, n: int, mask: np.ndarray | None = None
+    ) -> np.ndarray:
+        """Assemble the [B] batch vectors from ``active`` slots and run
+        ``n`` scanned decode steps in one compiled dispatch; returns the
+        sampled tokens [B, n] (ONE host sync for the whole scan). A host
+        ``mask`` ([B, V] bool) only composes with n == 1 — host masks must
+        be re-evaluated between steps."""
+        eng = self.engine
+        B = self.B
         tokens = np.zeros((B, 1), dtype=np.int32)
         temps = np.zeros((B,), dtype=np.float32)
         topks = np.zeros((B,), dtype=np.int32)
         topps = np.ones((B,), dtype=np.float32)
         gstates = np.full((B,), -1, dtype=np.int32)
         gremain = np.zeros((B,), dtype=np.int32)
-        masked = bool(masks)
         grammared = False
-        mask = np.ones((B, V), dtype=bool) if masked else None
-        for b, s in enumerate(self._slots):
-            if s is None or s.prefilling:
-                continue
+        for b, s in active:
             tokens[b, 0] = s.next_input
             temps[b] = s.gen.temperature
             topks[b] = s.gen.top_k
             topps[b] = s.gen.top_p
-            if masked and b in masks:
-                mask[b] = masks[b]
             if s.grammar is not None and s.gstate >= 0:
                 # the [B] state/budget vectors ride the same upload as the
                 # token ids; the [S, V] table never leaves the device
                 gstates[b] = s.gstate
                 gremain[b] = s.budget - len(s.generated)
                 grammared = True
-
-        if masked:
-            # every host-evaluated mask pays a [B, V] upload — the metric
-            # the device-native grammar path is measured against
-            METRICS.incr("scheduler.host_mask_uploads", len(masks))
-        step = self._step_fn(masked, grammared)
+        step = self._multi_fn(n, grammared, masked=mask is not None)
         args = [eng.params, self._pool, jnp.asarray(tokens), self._keys,
                 jnp.asarray(temps), jnp.asarray(topks), jnp.asarray(topps)]
         kw = {}
@@ -1139,16 +1211,73 @@ class PagedScheduler:
                 gstates=jnp.asarray(gstates), gremain=jnp.asarray(gremain),
                 table=self._gtable, mind=self._gmind,
             )
-        if masked:
+        if mask is not None:
             kw["mask"] = jnp.asarray(mask)
         with METRICS.span("decode_step"):
             nxt, self._pool, self._keys = step(*args, **kw)
-            toks = np.asarray(nxt)  # host sync inside the span
+            return np.asarray(nxt)  # host sync inside the span
 
-        for b, s in list(enumerate(self._slots)):
-            if s is None or s.prefilling:
-                continue
-            self._deliver(s, int(toks[b]))
+    def _multi_fn(self, n_steps: int, grammared: bool, masked: bool = False):
+        """The scanned decode-step program: every scheduler decode — the
+        single step (n=1, optionally host-masked) and the multi-step turbo
+        scan — shares this one body, so grammar/sampling semantics cannot
+        drift between paths."""
+        key = ("multi", n_steps, grammared, masked)
+        if key not in self._step_jit:
+            cfg = self.engine.cfg
+            mesh = self.engine.mesh  # tp mesh: kernel runs via shard_map
+
+            def multi(params, pool, tokens, keys, temps, topks, topps,
+                      gstates=None, gremain=None, table=None, mind=None,
+                      mask=None):
+                from fei_tpu.engine.grammar import feasible_mask
+
+                def body(carry, _):
+                    if grammared:
+                        pool, tokens, keys, gstates, gremain = carry
+                    else:
+                        pool, tokens, keys = carry
+                    logits, pool = forward_paged(
+                        params, cfg, tokens, pool, kernel_mesh=mesh
+                    )
+                    logits = logits[:, -1, :]
+                    if grammared:
+                        # per-slot DFA mask, entirely on device: slots with
+                        # gstate < 0 (free/unconstrained) pass through.
+                        # Budget feasibility is the shared rule
+                        # (grammar.feasible_mask, same as the dense scan).
+                        use = gstates >= 0
+                        srow = table[jnp.maximum(gstates, 0)]  # [B, V]
+                        gmask = feasible_mask(srow, mind, gremain, xp=jnp)
+                        gmask = jnp.where(use[:, None], gmask, True)
+                        logits = jnp.where(gmask, logits, -jnp.inf)
+                    if masked:
+                        logits = jnp.where(mask, logits, -jnp.inf)
+                    outs = jax.vmap(jax.random.split)(keys)  # [B, 2, 2]
+                    new_keys, subs = outs[:, 0], outs[:, 1]
+                    nxt = sample_logits_dynamic(
+                        logits, subs, temps, topks, topps
+                    )
+                    if grammared:
+                        nstate = jnp.take_along_axis(
+                            srow, nxt[:, None], axis=1
+                        )[:, 0].astype(jnp.int32)
+                        gstates = jnp.where(use, nstate, gstates)
+                        gremain = jnp.where(use, gremain - 1, gremain)
+                        carry = (pool, nxt[:, None], new_keys, gstates, gremain)
+                    else:
+                        carry = (pool, nxt[:, None], new_keys)
+                    return carry, nxt
+
+                init = (
+                    (pool, tokens, keys, gstates, gremain) if grammared
+                    else (pool, tokens, keys)
+                )
+                carry, toks = jax.lax.scan(body, init, None, length=n_steps)
+                return jnp.swapaxes(toks, 0, 1), carry[0], carry[2]
+
+            self._step_jit[key] = jax.jit(multi, donate_argnums=(1,))
+        return self._step_jit[key]
 
     def _finish(self, seq: _Seq) -> None:
         seq.finished = True
@@ -1339,37 +1468,3 @@ class PagedScheduler:
             self._admit_jit[key] = jax.jit(admit, donate_argnums=(0,))
         return self._admit_jit[key]
 
-    def _step_fn(self, masked: bool, grammared: bool = False):
-        key = (masked, grammared)
-        if key not in self._step_jit:
-            cfg = self.engine.cfg
-            mesh = self.engine.mesh  # tp mesh: kernel runs via shard_map
-
-            def step(params, pool, tokens, keys, temps, topks, topps,
-                     gstates=None, gremain=None, table=None, mind=None,
-                     mask=None):
-                logits, pool = forward_paged(
-                    params, cfg, tokens, pool, kernel_mesh=mesh
-                )
-                logits = logits[:, -1, :]
-                if grammared:
-                    # per-slot DFA mask, entirely on device: slots with
-                    # gstate < 0 (free/unconstrained) pass through. Budget
-                    # feasibility is the shared rule (grammar.feasible_mask,
-                    # same as the dense fused scan).
-                    from fei_tpu.engine.grammar import feasible_mask
-
-                    use = gstates >= 0
-                    srow = table[jnp.maximum(gstates, 0)]  # [B, V]
-                    gmask = feasible_mask(srow, mind, gremain, xp=jnp)
-                    gmask = jnp.where(use[:, None], gmask, True)
-                    logits = jnp.where(gmask, logits, -jnp.inf)
-                if masked:
-                    logits = jnp.where(mask, logits, -jnp.inf)
-                outs = jax.vmap(jax.random.split)(keys)  # [B, 2, 2]
-                new_keys, subs = outs[:, 0], outs[:, 1]
-                nxt = sample_logits_dynamic(logits, subs, temps, topks, topps)
-                return nxt, pool, new_keys
-
-            self._step_jit[key] = jax.jit(step, donate_argnums=(1,))
-        return self._step_jit[key]
