@@ -7,19 +7,59 @@
 # Covers VERDICT r2 items 1-2: the 8B int8 gate bench plus Mosaic
 # validation of every kernel added while the chip was down (flash backward,
 # int8-KV decode, multi-query ragged verification, paged/moe suites).
+#
+# The report is rewritten into the repo after EVERY stage, so results
+# survive even if a later stage hangs and the session ends: the driver
+# commits uncommitted work at round end.
 set -u
 OUT="${OUT:-/tmp/onchip}"
+REPORT="${REPORT:-/root/repo/ONCHIP_RESULTS.md}"
 mkdir -p "$OUT"
 cd /root/repo
+: > "$OUT/pipeline.log"  # per-run logs: re-runs must not inherit old state
+: > "$OUT/stages.lst"
 echo "=== pipeline start $(date -u) ===" >> "$OUT/pipeline.log"
+
+report() {
+  {
+    echo "# On-chip validation results"
+    echo
+    echo "Produced by scripts/onchip_pipeline.sh at the first successful"
+    echo "backend attach. Stage logs: $OUT/. Rewritten after every stage."
+    echo
+    echo '## Pipeline log (this run)'
+    echo '```'
+    cat "$OUT/pipeline.log"
+    echo '```'
+    local name
+    while read -r name; do
+      if [ -f "$OUT/$name.log" ]; then
+        echo
+        echo "## $name"
+        echo '```'
+        tail -30 "$OUT/$name.log"
+        echo '```'
+      fi
+    done < "$OUT/stages.lst"
+  } > "$REPORT.tmp"
+  mv -f "$REPORT.tmp" "$REPORT"  # atomic: a mid-write kill can't truncate
+}
 
 stage() {
   local name="$1"; shift
+  echo "$name" >> "$OUT/stages.lst"  # single source of truth for report()
   echo "[$(date -u +%H:%M:%S)] stage $name start" >> "$OUT/pipeline.log"
   "$@" > "$OUT/$name.log" 2>&1
   local rc=$?  # capture BEFORE echo: $(date) in the echo word resets $?
   echo "[$(date -u +%H:%M:%S)] stage $name rc=$rc" >> "$OUT/pipeline.log"
+  report
 }
+
+# 0. tunnel latency + single-jit init characterization (session-local
+# probe; logs to stdout, which stage() captures)
+if [ -f /tmp/tpu_probe.py ]; then
+  stage probe python -u /tmp/tpu_probe.py
+fi
 
 # 1. THE GATE: 8B int8 decode bench (the driver's default metric)
 stage bench_8b_int8 env FEI_TPU_BENCH_MAX_WAIT_S=300 python -u bench.py
@@ -45,4 +85,5 @@ stage bench_paged_kv8 env FEI_TPU_BENCH_SUITE=paged FEI_TPU_BENCH_KV_QUANT=int8 
   FEI_TPU_BENCH_MAX_WAIT_S=300 python -u bench.py
 
 echo "=== pipeline done $(date -u) ===" >> "$OUT/pipeline.log"
+report
 touch "$OUT/DONE"
