@@ -29,6 +29,8 @@ class ModelConfig:
     # MoE (Mixtral): num_experts == 0 means dense MLP
     num_experts: int = 0
     num_experts_per_tok: int = 2
+    # Qwen2-family attention: q/k/v projections carry biases (o does not)
+    attn_bias: bool = False
     # tokenizer/bos/eos defaults (overridden by a real tokenizer when loaded)
     bos_token_id: int = 1
     eos_token_id: int = 2
@@ -46,6 +48,8 @@ class ModelConfig:
         h, i, v, L = self.hidden_size, self.intermediate_size, self.vocab_size, self.num_layers
         d = self.head_dim_
         attn = h * (self.num_heads * d) + 2 * h * (self.num_kv_heads * d) + (self.num_heads * d) * h
+        if self.attn_bias:
+            attn += self.num_heads * d + 2 * self.num_kv_heads * d
         if self.is_moe:
             mlp = self.num_experts * 3 * h * i + h * self.num_experts
         else:
@@ -136,6 +140,24 @@ MODEL_CONFIGS: dict[str, ModelConfig] = {
         intermediate_size=14336, num_layers=32, num_heads=32, num_kv_heads=8,
         rope_theta=1000000.0, max_seq_len=32768,
         num_experts=8, num_experts_per_tok=2,
+    ),
+    # Qwen2 family (qkv biases; otherwise the same pre-norm GQA block)
+    "tiny-bias": ModelConfig(
+        name="tiny-bias", vocab_size=512, hidden_size=64,
+        intermediate_size=128, num_layers=2, num_heads=4, num_kv_heads=2,
+        max_seq_len=2048, attn_bias=True,
+    ),
+    "qwen2-0.5b": ModelConfig(
+        name="qwen2-0.5b", vocab_size=151936, hidden_size=896,
+        intermediate_size=4864, num_layers=24, num_heads=14, num_kv_heads=2,
+        rope_theta=1000000.0, max_seq_len=32768, tie_embeddings=True,
+        attn_bias=True, bos_token_id=151643, eos_token_id=151645,
+    ),
+    "qwen2-7b": ModelConfig(
+        name="qwen2-7b", vocab_size=152064, hidden_size=3584,
+        intermediate_size=18944, num_layers=28, num_heads=28, num_kv_heads=4,
+        rope_theta=1000000.0, max_seq_len=32768,
+        attn_bias=True, bos_token_id=151643, eos_token_id=151645,
     ),
 }
 

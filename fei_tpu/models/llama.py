@@ -98,6 +98,12 @@ def init_params(
             "wo": init(next(keys), (L, H * d, h), H * d, quant=True),
             "mlp_norm": jnp.ones((L, h), dtype=dtype),
         }
+        if cfg.attn_bias:  # Qwen2-style qkv biases (o_proj stays bias-free)
+            layers.update(
+                bq=jnp.zeros((L, H * d), dtype=dtype),
+                bk=jnp.zeros((L, K * d), dtype=dtype),
+                bv=jnp.zeros((L, K * d), dtype=dtype),
+            )
         if cfg.is_moe:
             E = cfg.num_experts
             layers.update(
@@ -174,6 +180,18 @@ def _moe(cfg: ModelConfig, y, lp, allow_routed: bool, moe_mesh=None):
     return fn(*args)
 
 
+def qkv_proj(lp, y, Hq: int, K: int, d: int):
+    """Project y -> (q [B,T,Hq,d], k [B,T,K,d], v [B,T,K,d]), applying the
+    Qwen2-style qkv biases when the layer carries them (cfg.attn_bias)."""
+    B, T, _ = y.shape
+    q, k, v = mm(y, lp["wq"]), mm(y, lp["wk"]), mm(y, lp["wv"])
+    if "bq" in lp:
+        q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
+    return (
+        q.reshape(B, T, Hq, d), k.reshape(B, T, K, d), v.reshape(B, T, K, d)
+    )
+
+
 def _attend(q, k, v, kv_length, positions):
     """Pick the attention path at trace time.
 
@@ -208,9 +226,7 @@ def _layer(
     Hq = cfg.num_heads
 
     y = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
-    q = mm(y, lp["wq"]).reshape(B, T, Hq, d)
-    k = mm(y, lp["wk"]).reshape(B, T, K, d)
-    v = mm(y, lp["wv"]).reshape(B, T, K, d)
+    q, k, v = qkv_proj(lp, y, Hq, K, d)
     q = apply_rope(q, cos, sin, positions)
     k = apply_rope(k, cos, sin, positions)
 
@@ -371,9 +387,7 @@ def forward_paged_block(
             lp, kp, vp = layer_inputs
             ksc = vsc = None
         y = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
-        q = mm(y, lp["wq"]).reshape(B, T, Hq, d)
-        k = mm(y, lp["wk"]).reshape(B, T, K, d)
-        v = mm(y, lp["wv"]).reshape(B, T, K, d)
+        q, k, v = qkv_proj(lp, y, Hq, K, d)
         q = apply_rope(q, cos, sin, positions)
         k = apply_rope(k, cos, sin, positions)
 

@@ -27,7 +27,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from fei_tpu.models.configs import ModelConfig
-from fei_tpu.models.llama import KVCache, _logits
+from fei_tpu.models.llama import KVCache, _logits, qkv_proj
 from fei_tpu.ops.moe import moe_mlp
 from fei_tpu.ops.quant import mm
 from fei_tpu.ops.rmsnorm import rms_norm
@@ -55,9 +55,7 @@ def _prefill_shard(
 
     def body(x, lp):
         y = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
-        q = mm(y, lp["wq"]).reshape(B, C, Hq, d)
-        k = mm(y, lp["wk"]).reshape(B, C, K, d)
-        v = mm(y, lp["wv"]).reshape(B, C, K, d)
+        q, k, v = qkv_proj(lp, y, Hq, K, d)
         q = apply_rope(q, cos, sin, positions)
         k = apply_rope(k, cos, sin, positions)
 

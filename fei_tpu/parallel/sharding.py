@@ -23,7 +23,7 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
-def param_specs(is_moe: bool) -> dict:
+def param_specs(is_moe: bool, attn_bias: bool = False) -> dict:
     """PartitionSpec pytree matching models/llama.py's param layout."""
     layers = {
         "attn_norm": P(),
@@ -33,6 +33,9 @@ def param_specs(is_moe: bool) -> dict:
         "wo": P(None, "tp", None),
         "mlp_norm": P(),
     }
+    if attn_bias:
+        # biases follow their projection's column (head-dim) split
+        layers.update(bq=P(None, "tp"), bk=P(None, "tp"), bv=P(None, "tp"))
     if is_moe:
         layers.update(
             router=P(),
@@ -88,14 +91,15 @@ def _tree_shardings(specs: dict, params: dict, mesh: Mesh) -> dict:
 
 
 def param_shardings(params: dict, mesh: Mesh, is_moe: bool) -> dict:
-    return _tree_shardings(param_specs(is_moe), params, mesh)
+    has_bias = "bq" in params.get("layers", {})
+    return _tree_shardings(param_specs(is_moe, has_bias), params, mesh)
 
 
 def param_shardings_from_cfg(cfg, mesh: Mesh) -> dict:
     """NamedSharding tree from the model config alone (no params needed) —
     feeds engine/weights.load_checkpoint's streamed per-shard read path so
     a checkpoint can load directly into sharded HBM."""
-    specs = param_specs(cfg.is_moe)
+    specs = param_specs(cfg.is_moe, getattr(cfg, "attn_bias", False))
     if cfg.tie_embeddings:
         specs.pop("lm_head", None)
 

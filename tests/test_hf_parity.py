@@ -95,6 +95,122 @@ class TestHFLogitParity:
         assert rel < 0.05, f"int8 relative error vs HF: {rel}"
 
 
+def _tiny_hf_qwen2(tmp_path, tie_embeddings=False):
+    cfg = transformers.Qwen2Config(
+        vocab_size=256,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=3,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=128,
+        rope_theta=10000.0,
+        rms_norm_eps=1e-5,
+        tie_word_embeddings=tie_embeddings,
+    )
+    torch.manual_seed(1)
+    model = transformers.Qwen2ForCausalLM(cfg).eval()
+    # transformers' _init_weights zeroes Linear biases; randomize the qkv
+    # biases so parity actually exercises the bias math
+    with torch.no_grad():
+        for layer in model.model.layers:
+            layer.self_attn.q_proj.bias.normal_(0, 0.5)
+            layer.self_attn.k_proj.bias.normal_(0, 0.5)
+            layer.self_attn.v_proj.bias.normal_(0, 0.5)
+    model.save_pretrained(str(tmp_path), safe_serialization=True)
+    return model, cfg
+
+
+class TestQwen2Parity:
+    """Qwen2 family: same pre-norm GQA block plus qkv biases
+    (cfg.attn_bias). Qwen2's HF config carries no attention_bias field —
+    the loader keys off model_type — so this test locks both the bias
+    math in qkv_proj and the config-merge path."""
+
+    @pytest.mark.parametrize("tie", [False, True])
+    def test_logits_match(self, tmp_path, tie):
+        model, _ = _tiny_hf_qwen2(tmp_path, tie_embeddings=tie)
+
+        ids = np.array([[1, 9, 43, 100, 4, 251, 18, 6]], dtype=np.int64)
+        with torch.no_grad():
+            want = model(torch.from_numpy(ids)).logits.float().numpy()
+
+        cfg = get_model_config("tiny")
+        cfg2, params = load_checkpoint(str(tmp_path), cfg, dtype=jnp.float32)
+        assert cfg2.attn_bias and "bq" in params["layers"]
+        # HF random init draws nonzero biases, so the bias path is live
+        assert float(np.abs(np.asarray(params["layers"]["bq"])).max()) > 0
+
+        cache = KVCache.create(cfg2, 1, ids.shape[1], jnp.float32)
+        got, _ = forward(params, cfg2, jnp.asarray(ids, jnp.int32), cache)
+
+        np.testing.assert_allclose(np.asarray(got)[0], want[0], atol=1e-3)
+
+    def test_random_init_matches_layout(self, tmp_path):
+        """init_params('tiny-bias') and the checkpoint loader must produce
+        the same pytree structure (the jitted programs are shared)."""
+        import jax
+
+        from fei_tpu.models.llama import init_params
+
+        _tiny_hf_qwen2(tmp_path)
+        cfg = get_model_config("tiny")
+        cfg2, loaded = load_checkpoint(str(tmp_path), cfg, dtype=jnp.float32)
+        inited = init_params(
+            get_model_config("tiny-bias"), jax.random.PRNGKey(0),
+            dtype=jnp.float32,
+        )
+        assert set(loaded["layers"]) == set(inited["layers"])
+
+
+def _tiny_hf_mixtral(tmp_path):
+    cfg = transformers.MixtralConfig(
+        vocab_size=256,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        num_local_experts=4,
+        num_experts_per_tok=2,
+        max_position_embeddings=128,
+        rope_theta=10000.0,
+        rms_norm_eps=1e-5,
+    )
+    torch.manual_seed(2)
+    model = transformers.MixtralForCausalLM(cfg).eval()
+    model.save_pretrained(str(tmp_path), safe_serialization=True)
+    return model, cfg
+
+
+class TestMixtralParity:
+    """MoE golden parity: router softmax/top-k normalization and expert
+    dispatch/combine against transformers' MixtralForCausalLM — the
+    routing math was previously pinned only against our own dense
+    oracle, never an external reference."""
+
+    @pytest.mark.parametrize("routed", ["0", "1"])
+    def test_logits_match(self, tmp_path, routed, monkeypatch):
+        monkeypatch.setenv("FEI_TPU_ROUTED_MOE", routed)
+        model, _ = _tiny_hf_mixtral(tmp_path)
+
+        ids = np.array([[1, 11, 47, 101, 5, 252, 19, 7]], dtype=np.int64)
+        with torch.no_grad():
+            want = model(torch.from_numpy(ids)).logits.float().numpy()
+
+        cfg = get_model_config("tiny")
+        cfg2, params = load_checkpoint(str(tmp_path), cfg, dtype=jnp.float32)
+        assert cfg2.is_moe and cfg2.num_experts == 4
+
+        cache = KVCache.create(cfg2, 1, ids.shape[1], jnp.float32)
+        got, _ = forward(
+            params, cfg2, jnp.asarray(ids, jnp.int32), cache,
+            routed_moe=(routed == "1"),
+        )
+
+        np.testing.assert_allclose(np.asarray(got)[0], want[0], atol=2e-3)
+
+
 class TestChatTemplateParity:
     def test_template_ids_identical(self, tmp_path):
         """Our HFTokenizer.apply_chat_template must produce byte-identical

@@ -35,11 +35,23 @@ def test_make_mesh_device_count_mismatch():
 
 @pytest.mark.parametrize(
     "name,shape",
-    [("tiny", {"dp": 2, "tp": 2}), ("tiny-moe", {"dp": 1, "tp": 2, "ep": 4})],
+    [
+        ("tiny", {"dp": 2, "tp": 2}),
+        ("tiny-moe", {"dp": 1, "tp": 2, "ep": 4}),
+        # qkv biases shard on the head dim with their projections
+        ("tiny-bias", {"dp": 2, "tp": 2}),
+    ],
 )
 def test_sharded_forward_matches_unsharded(name, shape):
     cfg = get_model_config(name)
     params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    if cfg.attn_bias:
+        # init zeroes biases; randomize so the bias+tp interaction is live
+        for k in ("bq", "bk", "bv"):
+            params["layers"][k] = 0.5 * jax.random.normal(
+                jax.random.PRNGKey(hash(k) % 2**31),
+                params["layers"][k].shape, dtype=jnp.float32,
+            )
     batch = 2 * shape.get("dp", 1)
     tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, 8), 0, cfg.vocab_size)
 
