@@ -175,6 +175,8 @@ class JaxLocalProvider(Provider):
     """The in-tree TPU decoder as an agent transport."""
 
     name = "jax_local"
+    # the serving endpoint may pass per-request sampling knobs
+    supports_gen_overrides = True
 
     def __init__(
         self,
@@ -302,9 +304,11 @@ class JaxLocalProvider(Provider):
                 out.append({"role": role, "content": str(m.get("content", ""))})
         return out
 
-    def complete(self, messages, system=None, tools=None, max_tokens=4000):
+    def complete(self, messages, system=None, tools=None, max_tokens=4000,
+                 gen_overrides=None):
         chunks = []
-        gen = self.stream(messages, system, tools, max_tokens)
+        gen = self.stream(messages, system, tools, max_tokens,
+                          gen_overrides=gen_overrides)
         while True:
             try:
                 chunks.append(next(gen))
@@ -312,11 +316,15 @@ class JaxLocalProvider(Provider):
                 resp = fin.value
                 return resp
 
-    def stream(self, messages, system=None, tools=None, max_tokens=4000):
+    def stream(self, messages, system=None, tools=None, max_tokens=4000,
+               gen_overrides=None):
+        """``gen_overrides`` (e.g. per-request temperature/top_p from the
+        serving endpoint) layer over the provider-level defaults."""
         full = self._messages_with_system(messages, system, tools)
         ids = self.engine.tokenizer.apply_chat_template(full, add_generation_prompt=True)
         gen = self._GenerationConfig(
-            max_new_tokens=max_tokens, **self.gen_overrides
+            max_new_tokens=max_tokens,
+            **{**self.gen_overrides, **(gen_overrides or {})},
         )
         out_ids: list[int] = []
         # Incremental decode: re-decoding the whole sequence per token is

@@ -49,10 +49,12 @@ _LAYER_MAP = {
     "w_gate": "model.layers.{i}.mlp.gate_proj.weight",
     "w_up": "model.layers.{i}.mlp.up_proj.weight",
     "w_down": "model.layers.{i}.mlp.down_proj.weight",
-    # Qwen2-family qkv biases (cfg.attn_bias; o_proj has none)
+    # qkv biases (cfg.attn_bias: Qwen2, or HF Llama attention_bias=true)
     "bq": "model.layers.{i}.self_attn.q_proj.bias",
     "bk": "model.layers.{i}.self_attn.k_proj.bias",
     "bv": "model.layers.{i}.self_attn.v_proj.bias",
+    # o_proj bias exists only for Llama-family attention_bias (cfg.o_bias)
+    "bo": "model.layers.{i}.self_attn.o_proj.bias",
 }
 _MOE_LAYER_MAP = {
     "router": "model.layers.{i}.block_sparse_moe.gate.weight",
@@ -240,6 +242,8 @@ def _plans(reader: _ShardReader, cfg: ModelConfig) -> dict:
         plans[("layers", "bq")] = stacked(_LAYER_MAP["bq"], (H * d,), False)
         plans[("layers", "bk")] = stacked(_LAYER_MAP["bk"], (K * d,), False)
         plans[("layers", "bv")] = stacked(_LAYER_MAP["bv"], (K * d,), False)
+    if cfg.o_bias:
+        plans[("layers", "bo")] = stacked(_LAYER_MAP["bo"], (h,), False)
     if not cfg.tie_embeddings:
         plans[("lm_head",)] = top("lm_head", (h, V), True)
     if cfg.is_moe:
@@ -457,10 +461,15 @@ def _merge_hf_config(ckpt_dir: str, cfg: ModelConfig) -> ModelConfig:
         num_experts_per_tok=hf.get("num_experts_per_tok"),
         bos_token_id=hf.get("bos_token_id"),
         eos_token_id=hf.get("eos_token_id"),
-        # Llama-family configs expose attention_bias; Qwen2's modeling code
-        # hardcodes qkv biases without a config field, so key off model_type
+        # Llama-family configs expose attention_bias (q/k/v AND o biases);
+        # Qwen2's modeling code hardcodes qkv-only biases without a config
+        # field, so key off model_type
         attn_bias=(
             True if hf.get("model_type") == "qwen2"
+            else hf.get("attention_bias")
+        ),
+        o_bias=(
+            False if hf.get("model_type") == "qwen2"
             else hf.get("attention_bias")
         ),
     )

@@ -31,6 +31,8 @@ class ModelConfig:
     num_experts_per_tok: int = 2
     # Qwen2-family attention: q/k/v projections carry biases (o does not)
     attn_bias: bool = False
+    # HF Llama-family `attention_bias: true` additionally biases o_proj
+    o_bias: bool = False
     # tokenizer/bos/eos defaults (overridden by a real tokenizer when loaded)
     bos_token_id: int = 1
     eos_token_id: int = 2
@@ -50,6 +52,8 @@ class ModelConfig:
         attn = h * (self.num_heads * d) + 2 * h * (self.num_kv_heads * d) + (self.num_heads * d) * h
         if self.attn_bias:
             attn += self.num_heads * d + 2 * self.num_kv_heads * d
+        if self.o_bias:
+            attn += h
         if self.is_moe:
             mlp = self.num_experts * 3 * h * i + h * self.num_experts
         else:

@@ -98,12 +98,14 @@ def init_params(
             "wo": init(next(keys), (L, H * d, h), H * d, quant=True),
             "mlp_norm": jnp.ones((L, h), dtype=dtype),
         }
-        if cfg.attn_bias:  # Qwen2-style qkv biases (o_proj stays bias-free)
+        if cfg.attn_bias:  # Qwen2-style qkv biases
             layers.update(
                 bq=jnp.zeros((L, H * d), dtype=dtype),
                 bk=jnp.zeros((L, K * d), dtype=dtype),
                 bv=jnp.zeros((L, K * d), dtype=dtype),
             )
+        if cfg.o_bias:  # HF Llama attention_bias=true also biases o_proj
+            layers["bo"] = jnp.zeros((L, h), dtype=dtype)
         if cfg.is_moe:
             E = cfg.num_experts
             layers.update(
@@ -241,7 +243,10 @@ def _layer(
         new_v = jax.vmap(write)(cache_v, v, kv_length)
 
     attn_out = _attend(q, new_k, new_v, kv_length, positions)
-    x = x + mm(attn_out.reshape(B, T, Hq * d), lp["wo"])
+    o = mm(attn_out.reshape(B, T, Hq * d), lp["wo"])
+    if "bo" in lp:  # HF Llama attention_bias=true also biases o_proj
+        o = o + lp["bo"]
+    x = x + o
 
     y = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
     if cfg.is_moe:
@@ -429,7 +434,10 @@ def forward_paged_block(
                     )  # [B, Hq, D]
                 attns.append(a)
             attn = jnp.stack(attns, axis=1)  # [B, T, Hq, D]
-        x = x + mm(attn.reshape(B, T, Hq * d), lp["wo"])
+        o = mm(attn.reshape(B, T, Hq * d), lp["wo"])
+        if "bo" in lp:
+            o = o + lp["bo"]
+        x = x + o
 
         y = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
         if cfg.is_moe:

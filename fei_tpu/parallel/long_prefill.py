@@ -67,7 +67,10 @@ def _prefill_shard(
             attn = _ring_attention_shard(
                 q, k, v, axis_name=axis_name, scale=d ** -0.5
             )
-        x = x + mm(attn.reshape(B, C, Hq * d), lp["wo"])
+        o = mm(attn.reshape(B, C, Hq * d), lp["wo"])
+        if "bo" in lp:
+            o = o + lp["bo"]
+        x = x + o
 
         y = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
         if cfg.is_moe:

@@ -23,7 +23,9 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
-def param_specs(is_moe: bool, attn_bias: bool = False) -> dict:
+def param_specs(
+    is_moe: bool, attn_bias: bool = False, o_bias: bool = False
+) -> dict:
     """PartitionSpec pytree matching models/llama.py's param layout."""
     layers = {
         "attn_norm": P(),
@@ -34,8 +36,12 @@ def param_specs(is_moe: bool, attn_bias: bool = False) -> dict:
         "mlp_norm": P(),
     }
     if attn_bias:
-        # biases follow their projection's column (head-dim) split
+        # qkv biases follow their projection's column (head-dim) split
         layers.update(bq=P(None, "tp"), bk=P(None, "tp"), bv=P(None, "tp"))
+    if o_bias:
+        # wo is row-parallel (contraction over tp); its bias adds once to
+        # the psummed output, so it replicates
+        layers["bo"] = P()
     if is_moe:
         layers.update(
             router=P(),
@@ -91,15 +97,21 @@ def _tree_shardings(specs: dict, params: dict, mesh: Mesh) -> dict:
 
 
 def param_shardings(params: dict, mesh: Mesh, is_moe: bool) -> dict:
-    has_bias = "bq" in params.get("layers", {})
-    return _tree_shardings(param_specs(is_moe, has_bias), params, mesh)
+    layers = params.get("layers", {})
+    return _tree_shardings(
+        param_specs(is_moe, "bq" in layers, "bo" in layers), params, mesh
+    )
 
 
 def param_shardings_from_cfg(cfg, mesh: Mesh) -> dict:
     """NamedSharding tree from the model config alone (no params needed) —
     feeds engine/weights.load_checkpoint's streamed per-shard read path so
     a checkpoint can load directly into sharded HBM."""
-    specs = param_specs(cfg.is_moe, getattr(cfg, "attn_bias", False))
+    specs = param_specs(
+        cfg.is_moe,
+        getattr(cfg, "attn_bias", False),
+        getattr(cfg, "o_bias", False),
+    )
     if cfg.tie_embeddings:
         specs.pop("lm_head", None)
 

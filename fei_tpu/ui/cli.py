@@ -394,6 +394,15 @@ def parse_args(argv: list[str] | None = None) -> argparse.Namespace:
     ask.add_argument("--count", type=int, default=5)
     ask.add_argument("--no-search", action="store_true",
                      help="skip the search step, ask the model directly")
+    serve = sub.add_parser(
+        "serve",
+        help="OpenAI-compatible /v1/chat/completions endpoint over the "
+             "jax_local serving stack (model via the root --model flag: "
+             "fei --model NAME serve)",
+    )
+    serve.add_argument("--host", default=None)
+    serve.add_argument("--port", type=int, default=None)
+    serve.add_argument("--api-key", default=None)
     return p.parse_args(argv)
 
 
@@ -408,6 +417,20 @@ def main(argv: list[str] | None = None) -> int:
         return handle_search_command(args)
     if args.command == "ask":
         return handle_ask_command(args)
+    if args.command == "serve":
+        # thin passthrough: server.py owns the flag defaults
+        from fei_tpu.ui.server import main as serve_main
+
+        serve_argv = []
+        if args.host:
+            serve_argv += ["--host", args.host]
+        if args.port is not None:
+            serve_argv += ["--port", str(args.port)]
+        if args.model:
+            serve_argv += ["--model", args.model]
+        if args.api_key:
+            serve_argv += ["--api-key", args.api_key]
+        return serve_main(serve_argv)
     history = History()
     try:
         assistant = build_assistant(args)

@@ -20,7 +20,7 @@ from fei_tpu.models.configs import get_model_config
 from fei_tpu.models.llama import KVCache, forward
 
 
-def _tiny_hf_llama(tmp_path, tie_embeddings=False):
+def _tiny_hf_llama(tmp_path, tie_embeddings=False, attention_bias=False):
     cfg = transformers.LlamaConfig(
         vocab_size=256,
         hidden_size=64,
@@ -32,9 +32,17 @@ def _tiny_hf_llama(tmp_path, tie_embeddings=False):
         rope_theta=10000.0,
         rms_norm_eps=1e-5,
         tie_word_embeddings=tie_embeddings,
+        attention_bias=attention_bias,
     )
     torch.manual_seed(0)
     model = transformers.LlamaForCausalLM(cfg).eval()
+    if attention_bias:
+        # transformers' _init_weights zeroes Linear biases; randomize so
+        # parity exercises the q/k/v AND o bias math
+        with torch.no_grad():
+            for layer in model.model.layers:
+                for proj in ("q_proj", "k_proj", "v_proj", "o_proj"):
+                    getattr(layer.self_attn, proj).bias.normal_(0, 0.5)
     model.save_pretrained(str(tmp_path), safe_serialization=True)
     return model, cfg
 
@@ -51,6 +59,26 @@ class TestHFLogitParity:
         cfg = get_model_config("tiny")  # every field overridden by config.json
         cfg2, params = load_checkpoint(str(tmp_path), cfg, dtype=jnp.float32)
         assert cfg2.num_kv_heads == 2 and cfg2.tie_embeddings == tie
+
+        cache = KVCache.create(cfg2, 1, ids.shape[1], jnp.float32)
+        got, _ = forward(params, cfg2, jnp.asarray(ids, jnp.int32), cache)
+
+        np.testing.assert_allclose(np.asarray(got)[0], want[0], atol=1e-3)
+
+    def test_attention_bias_logits_match(self, tmp_path):
+        """HF Llama attention_bias=true biases q/k/v AND o_proj — all four
+        must load and apply (cfg.attn_bias + cfg.o_bias)."""
+        model, _ = _tiny_hf_llama(tmp_path, attention_bias=True)
+
+        ids = np.array([[1, 8, 44, 98, 2, 249, 16, 4]], dtype=np.int64)
+        with torch.no_grad():
+            want = model(torch.from_numpy(ids)).logits.float().numpy()
+
+        cfg = get_model_config("tiny")
+        cfg2, params = load_checkpoint(str(tmp_path), cfg, dtype=jnp.float32)
+        assert cfg2.attn_bias and cfg2.o_bias
+        assert {"bq", "bk", "bv", "bo"} <= set(params["layers"])
+        assert float(np.abs(np.asarray(params["layers"]["bo"])).max()) > 0
 
         cache = KVCache.create(cfg2, 1, ids.shape[1], jnp.float32)
         got, _ = forward(params, cfg2, jnp.asarray(ids, jnp.int32), cache)
