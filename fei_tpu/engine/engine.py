@@ -48,6 +48,7 @@ class GenerationConfig:
     top_k: int = 0
     top_p: float = 1.0
     seed: int = 0
+    min_p: float = 0.0  # drop tokens with prob < min_p * max-prob
     stop_token_ids: tuple[int, ...] = ()
     ignore_eos: bool = False  # benchmark mode: decode the full budget
 
@@ -244,12 +245,14 @@ class InferenceEngine:
     def _step_fn(self, gen: GenerationConfig) -> Callable:
         """Compiled single-token decode step (dense cache donated; paged
         decode lives in scheduler.PagedScheduler)."""
-        key = (gen.temperature, gen.top_k, gen.top_p)
+        key = (gen.temperature, gen.top_k, gen.top_p, gen.min_p)
         if key not in self._step_cache:
             cfg = self.cfg
             routed = self.mesh is None
             moe_mesh = self._moe_mesh()
-            temperature, top_k, top_p = gen.temperature, gen.top_k, gen.top_p
+            temperature, top_k, top_p, min_p = (
+                gen.temperature, gen.top_k, gen.top_p, gen.min_p
+            )
 
             def step(params, cache, token, rng, logit_mask):
                 logits, cache = forward(
@@ -261,7 +264,8 @@ class InferenceEngine:
                     logits = jnp.where(logit_mask, logits, -jnp.inf)
                 rng, sub = jax.random.split(rng)
                 next_token = sample_logits(
-                    logits, sub, temperature=temperature, top_k=top_k, top_p=top_p
+                    logits, sub, temperature=temperature, top_k=top_k,
+                    top_p=top_p, min_p=min_p,
                 )
                 return next_token, cache, rng
 
@@ -275,14 +279,16 @@ class InferenceEngine:
         the scan — mask = table[state] >= 0 gated by budget feasibility,
         state' = table[state, token] — so constrained tool-call decoding
         pays zero per-token host round-trips (SURVEY.md hard part #3)."""
-        key = ("grammar", gen.temperature, gen.top_k, gen.top_p, n_steps)
+        key = ("grammar", gen.temperature, gen.top_k, gen.top_p, gen.min_p, n_steps)
         if key not in self._fused_cache:
             cfg = self.cfg
             fwd = functools.partial(
                 forward, routed_moe=self.mesh is None,
                 moe_mesh=self._moe_mesh(),
             )
-            temperature, top_k, top_p = gen.temperature, gen.top_k, gen.top_p
+            temperature, top_k, top_p, min_p = (
+                gen.temperature, gen.top_k, gen.top_p, gen.min_p
+            )
 
             def fused(params, cache, token, rng, gstate, remaining, table, min_dist):
                 # gstate: [B] int32 DFA state; remaining: [] int32 budget
@@ -303,7 +309,7 @@ class InferenceEngine:
                     rng, sub = jax.random.split(rng)
                     nxt = sample_logits(
                         logits, sub,
-                        temperature=temperature, top_k=top_k, top_p=top_p,
+                        temperature=temperature, top_k=top_k, top_p=top_p, min_p=min_p,
                     )
                     # table may be int16 (128k-vocab grammars halve their
                     # bytes); the carry state stays int32
@@ -425,14 +431,16 @@ class InferenceEngine:
         ms over a tunneled chip); this amortizes it to one per chunk, which
         is what bench-grade throughput and batch generation use. The cache
         (dense or paged pool) is donated through the scan."""
-        key = (gen.temperature, gen.top_k, gen.top_p, n_steps)
+        key = (gen.temperature, gen.top_k, gen.top_p, gen.min_p, n_steps)
         if key not in self._fused_cache:
             cfg = self.cfg
             fwd = functools.partial(
                 forward, routed_moe=self.mesh is None,
                 moe_mesh=self._moe_mesh(),
             )
-            temperature, top_k, top_p = gen.temperature, gen.top_k, gen.top_p
+            temperature, top_k, top_p, min_p = (
+                gen.temperature, gen.top_k, gen.top_p, gen.min_p
+            )
 
             def fused(params, cache, token, rng):  # token: [B, 1]
                 def body(carry, _):
@@ -441,7 +449,7 @@ class InferenceEngine:
                     rng, sub = jax.random.split(rng)
                     nxt = sample_logits(
                         logits[:, -1, :], sub,
-                        temperature=temperature, top_k=top_k, top_p=top_p,
+                        temperature=temperature, top_k=top_k, top_p=top_p, min_p=min_p,
                     )
                     return (cache, nxt[:, None], rng), nxt
 
@@ -547,6 +555,7 @@ class InferenceEngine:
         tok = sample_logits(
             last_logits, sub,
             temperature=gen.temperature, top_k=gen.top_k, top_p=gen.top_p,
+            min_p=gen.min_p,
         )
         return tok, cache, rng
 

@@ -1,4 +1,4 @@
-"""On-device token sampling: greedy / temperature / top-k / top-p.
+"""On-device token sampling: greedy / temperature / top-k / top-p / min-p.
 
 Runs inside the jitted decode step so no logits ever cross the host boundary
 — only the sampled token id does. All branches are static (chosen at trace
@@ -17,12 +17,19 @@ def sample_logits(
     temperature: float = 0.0,
     top_k: int = 0,
     top_p: float = 1.0,
+    min_p: float = 0.0,
 ) -> jnp.ndarray:
-    """Return sampled token ids [B]."""
+    """Return sampled token ids [B]. ``min_p`` drops tokens whose prob is
+    below min_p * max-prob (a relative floor that adapts to confidence,
+    unlike top_p's fixed mass)."""
     if temperature <= 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
     logits = logits / temperature
+    if min_p > 0.0:
+        probs = jax.nn.softmax(logits, axis=-1)
+        floor = min_p * jnp.max(probs, axis=-1, keepdims=True)
+        logits = jnp.where(probs < floor, -jnp.inf, logits)
     if top_k > 0 and top_k < logits.shape[-1]:
         kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
@@ -43,15 +50,21 @@ def _sample_row_dynamic(
     temperature: jnp.ndarray,  # [] float32
     top_k: jnp.ndarray,  # [] int32 (0 = off)
     top_p: jnp.ndarray,  # [] float32 (1.0 = off)
+    min_p: jnp.ndarray,  # [] float32 (0.0 = off)
 ) -> jnp.ndarray:
     """One sequence's sample with *traced* sampling knobs.
 
-    Mirrors ``sample_logits`` exactly (same filters, same key usage) but all
-    branches are data-dependent ``where``s, so one compiled program serves
-    every per-sequence config in a continuous batch."""
+    Mirrors ``sample_logits`` exactly (same filters, same filter order,
+    same key usage) but all branches are data-dependent ``where``s, so one
+    compiled program serves every per-sequence config in a continuous
+    batch."""
     V = logits.shape[-1]
     greedy = jnp.argmax(logits).astype(jnp.int32)
     scaled = logits / jnp.maximum(temperature, 1e-8)
+    apply_mp = min_p > 0.0
+    mp_probs = jax.nn.softmax(scaled)
+    floor = min_p * jnp.max(mp_probs)
+    scaled = jnp.where(apply_mp & (mp_probs < floor), -jnp.inf, scaled)
     sorted_desc = jnp.sort(scaled)[::-1]
     apply_k = (top_k > 0) & (top_k < V)
     kth = sorted_desc[jnp.clip(top_k - 1, 0, V - 1)]
@@ -72,7 +85,12 @@ def sample_logits_dynamic(
     temperatures: jnp.ndarray,  # [B]
     top_ks: jnp.ndarray,  # [B] int32
     top_ps: jnp.ndarray,  # [B]
+    min_ps: jnp.ndarray | None = None,  # [B] (None = off for all rows)
 ) -> jnp.ndarray:
     """Per-sequence sampling for the continuous-batching scheduler: each row
-    has its own key/temperature/top-k/top-p. Returns token ids [B]."""
-    return jax.vmap(_sample_row_dynamic)(logits, keys, temperatures, top_ks, top_ps)
+    has its own key/temperature/top-k/top-p/min-p. Returns token ids [B]."""
+    if min_ps is None:
+        min_ps = jnp.zeros_like(temperatures)
+    return jax.vmap(_sample_row_dynamic)(
+        logits, keys, temperatures, top_ks, top_ps, min_ps
+    )

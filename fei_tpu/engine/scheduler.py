@@ -29,7 +29,7 @@ Design notes
 - Inactive slots still flow through the batched forward (static shapes);
   their block-table rows are zeroed at eviction so their KV writes land in
   the reserved null page 0 and can never corrupt a live sequence's pages.
-- Per-slot sampling (temperature/top-k/top-p) uses sample_logits_dynamic —
+- Per-slot sampling (temperature/top-k/top-p/min-p) uses sample_logits_dynamic —
   traced knobs, one compiled program for every config mix.
 """
 
@@ -868,6 +868,7 @@ class PagedScheduler:
                 last_logits, sub,
                 temperature=seq.gen.temperature,
                 top_k=seq.gen.top_k, top_p=seq.gen.top_p,
+                min_p=seq.gen.min_p,
             )[0]
         )
         return tok0, rng
@@ -1188,6 +1189,7 @@ class PagedScheduler:
         temps = np.zeros((B,), dtype=np.float32)
         topks = np.zeros((B,), dtype=np.int32)
         topps = np.ones((B,), dtype=np.float32)
+        minps = np.zeros((B,), dtype=np.float32)
         gstates = np.full((B,), -1, dtype=np.int32)
         gremain = np.zeros((B,), dtype=np.int32)
         grammared = False
@@ -1196,6 +1198,7 @@ class PagedScheduler:
             temps[b] = s.gen.temperature
             topks[b] = s.gen.top_k
             topps[b] = s.gen.top_p
+            minps[b] = s.gen.min_p
             if s.grammar is not None and s.gstate >= 0:
                 # the [B] state/budget vectors ride the same upload as the
                 # token ids; the [S, V] table never leaves the device
@@ -1204,7 +1207,8 @@ class PagedScheduler:
                 grammared = True
         step = self._multi_fn(n, grammared, masked=mask is not None)
         args = [eng.params, self._pool, jnp.asarray(tokens), self._keys,
-                jnp.asarray(temps), jnp.asarray(topks), jnp.asarray(topps)]
+                jnp.asarray(temps), jnp.asarray(topks), jnp.asarray(topps),
+                jnp.asarray(minps)]
         kw = {}
         if grammared:
             kw.update(
@@ -1228,8 +1232,8 @@ class PagedScheduler:
             mesh = self.engine.mesh  # tp mesh: kernel runs via shard_map
 
             def multi(params, pool, tokens, keys, temps, topks, topps,
-                      gstates=None, gremain=None, table=None, mind=None,
-                      mask=None):
+                      minps, gstates=None, gremain=None, table=None,
+                      mind=None, mask=None):
                 from fei_tpu.engine.grammar import feasible_mask
 
                 def body(carry, _):
@@ -1256,7 +1260,7 @@ class PagedScheduler:
                     outs = jax.vmap(jax.random.split)(keys)  # [B, 2, 2]
                     new_keys, subs = outs[:, 0], outs[:, 1]
                     nxt = sample_logits_dynamic(
-                        logits, subs, temps, topks, topps
+                        logits, subs, temps, topks, topps, minps
                     )
                     if grammared:
                         nstate = jnp.take_along_axis(

@@ -103,6 +103,8 @@ def _gen_overrides(body: dict) -> dict:
         over["top_p"] = float(body["top_p"])
     if body.get("top_k") is not None:  # non-OpenAI extension
         over["top_k"] = int(body["top_k"])
+    if body.get("min_p") is not None:  # non-OpenAI extension (vLLM-style)
+        over["min_p"] = min(max(float(body["min_p"]), 0.0), 1.0)
     if body.get("seed") is not None:
         over["seed"] = int(body["seed"])
     return over
