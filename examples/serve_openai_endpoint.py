@@ -1,0 +1,73 @@
+"""The full in-tree serving loop: engine -> OpenAI endpoint -> OpenAI client.
+
+Starts `fei serve`'s ServingServer over a tiny paged engine, then talks to
+it two ways:
+  1. a raw OpenAI-protocol request (urllib), streaming and non-streaming;
+  2. our own RemoteProvider pointed at the endpoint — the transport shape
+     the reference used for external APIs (fei/core/assistant.py:524-530),
+     now closed onto the in-tree engine: agent, protocol, and decoder all
+     local, zero external API calls.
+
+Run: JAX_PLATFORMS=cpu python examples/serve_openai_endpoint.py
+"""
+
+import json
+import urllib.request
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from fei_tpu.agent.providers import JaxLocalProvider, RemoteProvider  # noqa: E402
+from fei_tpu.engine.engine import InferenceEngine  # noqa: E402
+from fei_tpu.ui.server import ServeAPI, ServingServer  # noqa: E402
+
+
+def main() -> None:
+    engine = InferenceEngine.from_config("tiny", paged=True, batch_size=2)
+    api = ServeAPI(JaxLocalProvider(engine=engine), model_name="tiny")
+    server = ServingServer(api)  # ephemeral port
+    server.start()
+    base = f"http://127.0.0.1:{server.port}"
+    print(f"serving on {base}/v1")
+
+    # 1a. plain completion
+    req = urllib.request.Request(
+        f"{base}/v1/chat/completions",
+        data=json.dumps({
+            "messages": [{"role": "user", "content": "hello engine"}],
+            "max_tokens": 12, "temperature": 0.8, "min_p": 0.1, "seed": 7,
+        }).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=300) as r:
+        body = json.loads(r.read())
+    print("completion:", repr(body["choices"][0]["message"]["content"]),
+          body["usage"])
+
+    # 1b. SSE stream
+    req = urllib.request.Request(
+        f"{base}/v1/chat/completions",
+        data=json.dumps({
+            "messages": [{"role": "user", "content": "stream please"}],
+            "max_tokens": 8, "stream": True,
+        }).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    n_chunks = 0
+    with urllib.request.urlopen(req, timeout=300) as r:
+        for line in r:
+            if line.strip().startswith(b"data: ") and b"[DONE]" not in line:
+                n_chunks += 1
+    print(f"streamed {n_chunks} SSE chunks")
+
+    # 2. our own remote provider against our own endpoint
+    rp = RemoteProvider(provider="openai", model="tiny", api_base=f"{base}/v1")
+    resp = rp.complete([{"role": "user", "content": "loop"}], max_tokens=8)
+    print("self-loop reply:", repr(resp.content))
+
+    server.stop()
+
+
+if __name__ == "__main__":
+    main()
