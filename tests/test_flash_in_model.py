@@ -24,15 +24,20 @@ class TestFlashPath:
         params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
         tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 24), 0, cfg.vocab_size)
 
-        cache = KVCache.create(cfg, 2, 64, dtype=jnp.float32)
-        flash_logits, _ = forward(params, cfg, tokens, cache)
+        # highest precision: on TPU the oracle's fp32 matmuls otherwise run
+        # as bf16 MXU passes while the Pallas kernel accumulates true fp32,
+        # and the 2-layer end-to-end delta blows past any sane tolerance.
+        with jax.default_matmul_precision("highest"):
+            cache = KVCache.create(cfg, 2, 64, dtype=jnp.float32)
+            flash_logits, _ = forward(params, cfg, tokens, cache)
 
-        monkeypatch.setenv("FEI_TPU_FLASH", "0")
-        cache = KVCache.create(cfg, 2, 64, dtype=jnp.float32)
-        oracle_logits, _ = forward(params, cfg, tokens, cache)
+            monkeypatch.setenv("FEI_TPU_FLASH", "0")
+            cache = KVCache.create(cfg, 2, 64, dtype=jnp.float32)
+            oracle_logits, _ = forward(params, cfg, tokens, cache)
 
+        atol = 5e-3 if jax.default_backend() == "tpu" else 2e-3
         np.testing.assert_allclose(
-            np.asarray(flash_logits), np.asarray(oracle_logits), atol=2e-3
+            np.asarray(flash_logits), np.asarray(oracle_logits), atol=atol
         )
 
     def test_greedy_generation_matches(self, flash_env, monkeypatch):
