@@ -68,7 +68,7 @@ def _make_engine(model: str, **kwargs):
     log(f"bench: params initialized in {time.time()-t0:.1f}s "
         f"(~{engine.cfg.num_params()/1e9:.2f}B params, "
         f"{param_bytes(engine.params)/1e9:.2f} GB on device"
-        f"{', int8' if quant else ''})")
+        f"{', ' + quant if quant else ''})")
     return engine
 
 

@@ -178,9 +178,20 @@ class InferenceEngine:
     ) -> "InferenceEngine":
         """``quantize="int8"`` converts the big linear weights to weight-only
         int8 (ops.quant) — halves weight HBM so e.g. an 8B fits one 16 GB
-        v5e chip; norms/router/embed stay in ``dtype``."""
-        if quantize not in (None, "int8"):
+        v5e chip; norms/router/embed stay in ``dtype``. ``quantize="int4"``
+        halves the stream again via nibble-packed QTensor4 + the Pallas
+        grouped-dequant matmul (lm_head and stacked MoE experts stay int8
+        — ops.quant._int4_ok). Single-chip only (any mesh is rejected):
+        QTensor4's nibble pairing spans the contraction axis, so TP
+        sharding would split pairs across devices."""
+        if quantize not in (None, "int8", "int4"):
             raise ValueError(f"unsupported quantize mode: {quantize!r}")
+        if quantize == "int4" and mesh is not None:
+            raise ValueError(
+                "quantize='int4' does not compose with a mesh yet (nibble "
+                "pairs span the contraction axis; TP would split them) — "
+                "use quantize='int8' for sharded serving"
+            )
         cfg = get_model_config(name, **overrides)
         tok = load_tokenizer(tokenizer)
         if checkpoint_dir:

@@ -156,12 +156,35 @@ def _norm_idx(idx: tuple, shape: tuple) -> tuple:
 
 def _quant_host(w: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
     """Host-side symmetric int8 over contraction axis -2 (matches
-    ops.quant.quantize)."""
+    ops.quant.quantize — the divisions stay float32 so rounding decisions
+    are bit-identical to the jnp implementation)."""
     w = w.astype(np.float32)
     amax = np.abs(w).max(axis=-2, keepdims=True)
-    s = np.where(amax == 0.0, 1.0, amax / 127.0)
+    s = np.where(amax == 0.0, np.float32(1.0), amax / np.float32(127.0))
     q = np.clip(np.round(w / s), -127, 127).astype(np.int8)
     return q, s.astype(np.float32)
+
+
+def _quant4_host(
+    w: np.ndarray, group: int | None = None
+) -> tuple[np.ndarray, np.ndarray]:
+    """Host-side int4 matching ops.quant.quantize4: symmetric ±7 per
+    (group, out-channel), nibble pairs (k, k + K/2) packed into int8. The
+    divisions stay float32 so rounding is bit-identical to quantize4."""
+    from fei_tpu.ops.quant import INT4_GROUP
+
+    group = group or INT4_GROUP
+    K = w.shape[-2]
+    G = K // group
+    w = w.astype(np.float32)
+    grouped = w.reshape(*w.shape[:-2], G, group, w.shape[-1])
+    amax = np.abs(grouped).max(axis=-2)
+    s = np.where(amax == 0.0, np.float32(1.0), amax / np.float32(7.0))
+    q = np.clip(
+        np.round(grouped / s[..., :, None, :]), -7, 7
+    ).astype(np.int8).reshape(w.shape)
+    lo, hi = q[..., : K // 2, :], q[..., K // 2 :, :]
+    return ((hi << 4) | (lo & 0xF)).astype(np.int8), s.astype(np.float32)
 
 
 class _TensorPlan:
@@ -373,6 +396,27 @@ def _build_quantized(plan: _TensorPlan, sharding) -> QTensor:
     return QTensor(q=q, s=s)
 
 
+def _build_quantized4(plan: _TensorPlan):
+    """int4 QTensor4, unsharded (int4 rejects meshes at the engine). The
+    read streams per leading-axis step (layer) so host fp32 peak stays at
+    one layer's weights, mirroring _build_quantized."""
+    from fei_tpu.ops.quant import QTensor4
+
+    shape = plan.shape
+    full = _full(shape)
+    if len(shape) >= 3:
+        ps, ss = [], []
+        for layer in range(shape[0]):
+            idx = (slice(layer, layer + 1),) + full[1:]
+            p1, s1 = _quant4_host(plan.read(idx))
+            ps.append(p1)
+            ss.append(s1)
+        return QTensor4(p=jnp.asarray(np.concatenate(ps)),
+                        s=jnp.asarray(np.concatenate(ss)))
+    p, s = _quant4_host(plan.read(full))
+    return QTensor4(p=jnp.asarray(p), s=jnp.asarray(s))
+
+
 def load_checkpoint(
     ckpt_dir: str,
     cfg: ModelConfig,
@@ -395,9 +439,18 @@ def load_checkpoint(
     TP/EP shardings are derived here from the (HF-merged) config.
 
     ``quantize="int8"``: big linear weights land as ops.quant.QTensor.
+    ``quantize="int4"``: int4-eligible leaves (ops.quant._int4_ok: not
+    lm_head, not stacked MoE experts, contraction divisible by 256) land as
+    QTensor4; the rest as int8 QTensor. Unsharded only (the engine rejects
+    int4 + mesh: nibble pairs span the contraction axis).
     """
-    if quantize not in (None, "int8"):
+    if quantize not in (None, "int8", "int4"):
         raise CheckpointError(f"unsupported quantize mode: {quantize!r}")
+    if quantize == "int4" and (shardings is not None or mesh is not None):
+        raise CheckpointError(
+            "quantize='int4' does not compose with sharded loading — "
+            "use quantize='int8' for sharded serving"
+        )
     cfg = _merge_hf_config(ckpt_dir, cfg)
     if shardings is None and mesh is not None:
         from fei_tpu.parallel.sharding import param_shardings_from_cfg
@@ -410,7 +463,16 @@ def load_checkpoint(
     for path, plan in plans.items():
         shard = _lookup(shardings, path) if shardings is not None else None
         key = path[-1]
-        if quantize == "int8" and key in QUANT_KEYS:
+        if quantize == "int4" and key in QUANT_KEYS:
+            from fei_tpu.ops.quant import _int4_ok
+
+            # _int4_ok only reads .shape[-2]; a plan quacks enough
+            leaf = (
+                _build_quantized4(plan)
+                if _int4_ok(key, plan, cfg.is_moe)
+                else _build_quantized(plan, None)
+            )
+        elif quantize == "int8" and key in QUANT_KEYS:
             if shard is not None:
                 from fei_tpu.parallel.sharding import _scale_spec
                 from jax.sharding import NamedSharding
@@ -433,7 +495,7 @@ def load_checkpoint(
         "loaded checkpoint from %s (%d layers%s%s)",
         ckpt_dir, cfg.num_layers,
         ", streamed-sharded" if shardings is not None else "",
-        ", int8" if quantize == "int8" else "",
+        f", {quantize}" if quantize else "",
     )
     return cfg, params
 
@@ -503,11 +565,26 @@ def _is_qtensor_shaped(q, s) -> bool:
     return len(mismatch) == 0 or (len(mismatch) == 1 and ss[mismatch[0]] == 1)
 
 
+def _is_qtensor4_shaped(p, s) -> bool:
+    """QTensor4 layout (ops/quant.py): packed [.., K/2, N] int8 beside a
+    grouped scale [.., K/g, N] whose group axis is a proper multiple —
+    2*K/2 divisible by the scale rows, same trailing dim, same rank."""
+    ps = getattr(p, "shape", None)
+    ss = getattr(s, "shape", None)
+    if ps is None or ss is None or len(ps) != len(ss) or len(ps) < 2:
+        return False
+    if ps[:-2] != ss[:-2] or ps[-1] != ss[-1]:
+        return False
+    K, G = 2 * ps[-2], ss[-2]
+    return G > 1 and K % G == 0 and (K // G) % 2 == 0
+
+
 def _retype_qtensors(tree):
     """Orbax round-trips NamedTuples as plain dicts; rebuild QTensor leaves
     (recognized by their exact {q: int8, s} field pair plus the keepdims
-    scale-shape relationship) so quantized checkpoints restore into working
-    pytrees."""
+    scale-shape relationship) and QTensor4 leaves ({p: int8, s} with the
+    grouped-scale relationship) so quantized checkpoints restore into
+    working pytrees."""
     if isinstance(tree, dict):
         if (
             set(tree.keys()) == {"q", "s"}
@@ -515,6 +592,14 @@ def _retype_qtensors(tree):
             and _is_qtensor_shaped(tree["q"], tree["s"])
         ):
             return QTensor(q=tree["q"], s=tree["s"])
+        if (
+            set(tree.keys()) == {"p", "s"}
+            and getattr(tree["p"], "dtype", None) == jnp.int8
+            and _is_qtensor4_shaped(tree["p"], tree["s"])
+        ):
+            from fei_tpu.ops.quant import QTensor4
+
+            return QTensor4(p=tree["p"], s=tree["s"])
         return {k: _retype_qtensors(v) for k, v in tree.items()}
     return tree
 

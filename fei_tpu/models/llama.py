@@ -26,7 +26,12 @@ import jax.numpy as jnp
 from fei_tpu.models.configs import ModelConfig
 from fei_tpu.ops.attention import attention
 from fei_tpu.ops.moe import moe_mlp, moe_mlp_routed
-from fei_tpu.ops.quant import mm, quantize as _quantize_w
+from fei_tpu.ops.quant import (
+    _int4_ok,
+    mm,
+    quantize as _quantize_w,
+    quantize4 as _quantize4_w,
+)
 from fei_tpu.ops.rmsnorm import rms_norm
 from fei_tpu.ops.rope import apply_rope, compute_rope_freqs
 
@@ -78,24 +83,27 @@ def init_params(
         keys = iter(jax.random.split(key, 16))
         prev = None  # barrier chain: orders tensor materialization
 
-        def init(k, shape, fan_in, quant=False):
+        def init(k, shape, fan_in, quant=False, name=None):
             nonlocal prev
             if prev is not None:
                 k, _ = jax.lax.optimization_barrier((k, prev))
             w = (
                 jax.random.normal(k, shape, dtype=jnp.float32) * (fan_in ** -0.5)
             ).astype(dtype)
-            if quant and quantize == "int8":
-                w = _quantize_w(w)
-            prev = w.q if hasattr(w, "q") else w
+            if quant and quantize:
+                if quantize == "int4" and _int4_ok(name, w, cfg.is_moe):
+                    w = _quantize4_w(w)
+                else:  # int8, and the int4 mode's int8-kept leaves
+                    w = _quantize_w(w)
+            prev = w.q if hasattr(w, "q") else (w.p if hasattr(w, "p") else w)
             return w
 
         layers: dict = {
             "attn_norm": jnp.ones((L, h), dtype=dtype),
-            "wq": init(next(keys), (L, h, H * d), h, quant=True),
-            "wk": init(next(keys), (L, h, K * d), h, quant=True),
-            "wv": init(next(keys), (L, h, K * d), h, quant=True),
-            "wo": init(next(keys), (L, H * d, h), H * d, quant=True),
+            "wq": init(next(keys), (L, h, H * d), h, quant=True, name="wq"),
+            "wk": init(next(keys), (L, h, K * d), h, quant=True, name="wk"),
+            "wv": init(next(keys), (L, h, K * d), h, quant=True, name="wv"),
+            "wo": init(next(keys), (L, H * d, h), H * d, quant=True, name="wo"),
             "mlp_norm": jnp.ones((L, h), dtype=dtype),
         }
         if cfg.attn_bias:  # Qwen2-style qkv biases
@@ -110,15 +118,15 @@ def init_params(
             E = cfg.num_experts
             layers.update(
                 router=init(next(keys), (L, h, E), h),
-                w_gate=init(next(keys), (L, E, h, I), h, quant=True),
-                w_up=init(next(keys), (L, E, h, I), h, quant=True),
-                w_down=init(next(keys), (L, E, I, h), I, quant=True),
+                w_gate=init(next(keys), (L, E, h, I), h, quant=True, name="w_gate"),
+                w_up=init(next(keys), (L, E, h, I), h, quant=True, name="w_up"),
+                w_down=init(next(keys), (L, E, I, h), I, quant=True, name="w_down"),
             )
         else:
             layers.update(
-                w_gate=init(next(keys), (L, h, I), h, quant=True),
-                w_up=init(next(keys), (L, h, I), h, quant=True),
-                w_down=init(next(keys), (L, I, h), I, quant=True),
+                w_gate=init(next(keys), (L, h, I), h, quant=True, name="w_gate"),
+                w_up=init(next(keys), (L, h, I), h, quant=True, name="w_up"),
+                w_down=init(next(keys), (L, I, h), I, quant=True, name="w_down"),
             )
         params = {
             "embed": init(next(keys), (cfg.vocab_size, h), h),
@@ -126,7 +134,9 @@ def init_params(
             "final_norm": jnp.ones((h,), dtype=dtype),
         }
         if not cfg.tie_embeddings:
-            params["lm_head"] = init(next(keys), (h, cfg.vocab_size), h, quant=True)
+            params["lm_head"] = init(
+                next(keys), (h, cfg.vocab_size), h, quant=True, name="lm_head"
+            )
         return params
 
     built = jax.jit(_build)

@@ -1,9 +1,13 @@
-"""Weight-only int8 quantization for the stacked Llama/Mixtral pytree.
+"""Weight-only int8/int4 quantization for the stacked Llama/Mixtral pytree.
 
 SURVEY.md §7 hard-part #4: 70B bf16 weights are ~140 GB but a v5e chip has
 16 GB HBM — even across a v5e-64 the bf16 layer weights leave little headroom
 for KV pages. Weight-only int8 halves weight HBM (and doubles effective
 weight-streaming bandwidth, the decode bottleneck) at <0.5% logit error.
+Weight-only int4 (QTensor4: nibble-packed, per-group-of-128 scales) halves
+it again; the packed matmul is a Pallas kernel (ops/pallas/int4_matmul.py)
+because an XLA formulation necessarily reads the packed bytes once per
+nibble plane — only a fused kernel streams them once.
 
 Scheme (TPU-first; the reference has no quantization — its LLM runs behind
 an HTTP API, fei/core/assistant.py:524-530):
@@ -33,6 +37,11 @@ QUANT_KEYS = frozenset(
     {"wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down", "lm_head"}
 )
 
+# int4 default group size along the contraction axis (GPTQ/AWQ-standard 128:
+# small enough that one outlier can't blow a whole channel's scale, large
+# enough that scale bytes stay ~3% of the packed weight)
+INT4_GROUP = 128
+
 
 class QTensor(NamedTuple):
     """int8 weight + per-out-channel scale.
@@ -54,6 +63,41 @@ class QTensor(NamedTuple):
         return self.s.dtype
 
 
+class QTensor4(NamedTuple):
+    """Weight-only int4: nibble-packed int8 + per-group scale.
+
+    p: int8, [.., K/2, out] — byte i packs logical contraction rows i (low
+       nibble) and i + K/2 (high nibble). Pairing rows a half apart (not
+       adjacent rows) means unpacking never interleaves: the matmul is
+       ``x[:, :K/2] @ lo + x[:, K/2:] @ hi``, so both the Pallas kernel and
+       the XLA fallback split cleanly into two half-contractions while the
+       packed bytes stream from HBM once (kernel) at half int8's footprint.
+    s: fp32 scale, [.., K/group, out] — row g scales logical contraction
+       rows [g*group, (g+1)*group). Group boundaries never straddle the
+       half split (K/2 is kept a multiple of the group size), so the lo
+       half reads scale rows [:K/(2g)] and the hi half the rest.
+
+    The group size is not stored: it is recovered as
+    ``2 * p.shape[-2] // s.shape[-2]``. Distinguished from QTensor
+    structurally by the grouped scale axis (QTensor's is collapsed to 1).
+    """
+
+    p: jnp.ndarray
+    s: jnp.ndarray
+
+    @property
+    def shape(self):  # the *logical* unpacked shape
+        return (*self.p.shape[:-2], self.p.shape[-2] * 2, self.p.shape[-1])
+
+    @property
+    def dtype(self):  # the *logical* dtype callers compute in
+        return self.s.dtype
+
+    @property
+    def group_size(self) -> int:
+        return 2 * self.p.shape[-2] // self.s.shape[-2]
+
+
 def quantize(w: jnp.ndarray, contract_axis: int = -2) -> QTensor:
     """Symmetric int8 with per-out-channel scale over ``contract_axis``."""
     amax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=contract_axis, keepdims=True)
@@ -62,10 +106,44 @@ def quantize(w: jnp.ndarray, contract_axis: int = -2) -> QTensor:
     return QTensor(q=q, s=s)
 
 
+def quantize4(w: jnp.ndarray, group: int = INT4_GROUP) -> QTensor4:
+    """Symmetric int4 (±7) with per-(group, out-channel) scale over the
+    contraction axis (-2). Requires K divisible by 2*group so nibble pairs
+    and scale groups both split cleanly at K/2."""
+    K = w.shape[-2]
+    if K % (2 * group) != 0:
+        raise ValueError(
+            f"int4 contraction dim {K} must be divisible by 2*group={2 * group}"
+        )
+    G = K // group
+    w32 = w.astype(jnp.float32)
+    grouped = w32.reshape(*w.shape[:-2], G, group, w.shape[-1])
+    amax = jnp.max(jnp.abs(grouped), axis=-2)  # [.., G, out]
+    s = jnp.where(amax == 0.0, 1.0, amax / 7.0)
+    q = jnp.clip(
+        jnp.round(grouped / s[..., :, None, :]), -7, 7
+    ).astype(jnp.int8).reshape(w.shape)
+    lo, hi = q[..., : K // 2, :], q[..., K // 2 :, :]
+    packed = ((hi << 4) | (lo & 0xF)).astype(jnp.int8)
+    return QTensor4(p=packed, s=s)
+
+
+def unpack4(p: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Packed int8 -> (lo, hi) int32 nibble planes, sign-extended."""
+    p32 = p.astype(jnp.int32)
+    return (p32 << 28) >> 28, p32 >> 4
+
+
 def dequantize(w, dtype=jnp.bfloat16):
-    """QTensor -> dense array; identity on plain arrays."""
+    """QTensor/QTensor4 -> dense array; identity on plain arrays."""
     if isinstance(w, QTensor):
         return (w.q.astype(jnp.float32) * w.s).astype(dtype)
+    if isinstance(w, QTensor4):
+        lo, hi = unpack4(w.p)
+        q = jnp.concatenate([lo, hi], axis=-2).astype(jnp.float32)
+        G, gs = w.s.shape[-2], w.group_size
+        grouped = q.reshape(*q.shape[:-2], G, gs, q.shape[-1])
+        return (grouped * w.s[..., :, None, :]).reshape(q.shape).astype(dtype)
     return w if w.dtype == dtype else w.astype(dtype)
 
 
@@ -80,6 +158,10 @@ def mm(x: jnp.ndarray, w) -> jnp.ndarray:
         out = x @ w.q.astype(x.dtype)
         # s: [.., 1, out] -> broadcast over x's leading dims on the result
         return out * jnp.squeeze(w.s, axis=-2).astype(x.dtype)
+    if isinstance(w, QTensor4):
+        from fei_tpu.ops.pallas.int4_matmul import int4_mm
+
+        return int4_mm(x, w)
     return x @ w
 
 
@@ -89,6 +171,8 @@ def wcast(w, dtype) -> jnp.ndarray:
     scale_expert_out / scale_rows), passthrough otherwise."""
     if isinstance(w, QTensor):
         return w.q.astype(dtype)
+    if isinstance(w, QTensor4):  # moe experts are kept int8 (_int4_ok)
+        raise TypeError("QTensor4 has no raw-operand form; use mm/dequantize")
     return w
 
 
@@ -115,15 +199,35 @@ def scale_rows(out: jnp.ndarray, w, expert_ids: jnp.ndarray) -> jnp.ndarray:
     return out * jnp.take(s, expert_ids, axis=0).astype(out.dtype)
 
 
-def quantize_params(params: dict) -> dict:
+def _int4_ok(key: str, w, moe: bool) -> bool:
+    """Whether a big-linear leaf takes int4 in mixed int4/int8 mode.
+
+    lm_head stays int8 (the final projection is the most scale-sensitive
+    linear — standard GPTQ/AWQ practice) and stacked MoE experts stay int8
+    (the einsum/ragged-dot expert paths consume raw int8 planes via wcast;
+    a nibble-packed operand has no ragged_dot formulation). Both still
+    halve bf16; everything else halves again.
+    """
+    if key == "lm_head" or (moe and key in ("w_gate", "w_up", "w_down")):
+        return False
+    return w.shape[-2] % (2 * INT4_GROUP) == 0
+
+
+def quantize_params(params: dict, bits: int = 8) -> dict:
     """Quantize the big linear weights of a stacked param pytree in place
-    of their bf16 leaves. Norms/router/embed are left untouched."""
+    of their bf16 leaves. Norms/router/embed are left untouched.
+    ``bits=4``: int4 where eligible (see _int4_ok), int8 elsewhere."""
+    moe = isinstance(params.get("layers"), dict) and "router" in params["layers"]
 
     def walk(tree):
         if isinstance(tree, dict):
             return {
-                k: quantize(v)
-                if k in QUANT_KEYS and not isinstance(v, QTensor)
+                k: (
+                    quantize4(v)
+                    if bits == 4 and _int4_ok(k, v, moe)
+                    else quantize(v)
+                )
+                if k in QUANT_KEYS and not isinstance(v, (QTensor, QTensor4))
                 else walk(v)
                 for k, v in tree.items()
             }
@@ -136,7 +240,11 @@ def dequantize_params(params: dict, dtype=jnp.bfloat16) -> dict:
     def walk(tree):
         if isinstance(tree, dict):
             return {k: walk(v) for k, v in tree.items()}
-        return dequantize(tree, dtype) if isinstance(tree, QTensor) else tree
+        return (
+            dequantize(tree, dtype)
+            if isinstance(tree, (QTensor, QTensor4))
+            else tree
+        )
 
     return walk(params)
 

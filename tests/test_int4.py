@@ -1,0 +1,230 @@
+"""Weight-only int4 (QTensor4 + Pallas grouped-dequant matmul).
+
+Layers of guarantee, mirroring the int8 suite (test_quant.py):
+- quantize4/dequantize roundtrip error is bounded by the group scale step
+- the XLA two-dot fallback equals an explicit dequantize-then-matmul
+- the Pallas kernel (interpret mode on CPU) equals the XLA fallback
+- an int4-quantized tiny model decodes greedily identically to the same
+  model with explicitly dequantized weights (the engine e2e contract)
+- mixed-tree rules: lm_head and MoE experts stay int8 (ops.quant._int4_ok)
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fei_tpu.ops.quant import (
+    QTensor,
+    QTensor4,
+    dequantize,
+    mm,
+    quantize4,
+    quantize_params,
+)
+from fei_tpu.ops.pallas.int4_matmul import int4_mm, int4_mm_xla
+
+
+class TestQuantize4:
+    def test_roundtrip_error_bounded_by_group_step(self):
+        w = jax.random.normal(jax.random.PRNGKey(0), (1024, 256)) * 0.05
+        qt = quantize4(w)
+        assert qt.p.shape == (512, 256) and qt.p.dtype == jnp.int8
+        assert qt.s.shape == (8, 256) and qt.group_size == 128
+        wd = dequantize(qt, jnp.float32)
+        # per-(group, channel) step = amax/7; error <= step/2
+        grouped = np.asarray(w, np.float32).reshape(8, 128, 256)
+        step = np.abs(grouped).max(axis=1) / 7.0
+        err = np.abs(np.asarray(wd).reshape(8, 128, 256) - grouped)
+        assert (err <= step[:, None, :] / 2 + 1e-7).all()
+
+    def test_packing_is_lossless(self):
+        """Nibble pack/unpack preserves every int4 level including -7/7."""
+        w = jax.random.normal(jax.random.PRNGKey(1), (512, 128))
+        qt = quantize4(w)
+        from fei_tpu.ops.quant import unpack4
+
+        lo, hi = unpack4(qt.p)
+        q = np.concatenate([np.asarray(lo), np.asarray(hi)], axis=0)
+        assert q.min() >= -7 and q.max() <= 7
+        # re-derive the reference quantization directly
+        w32 = np.asarray(w, np.float32).reshape(4, 128, 128)
+        s = np.abs(w32).max(axis=1, keepdims=True) / 7.0
+        ref = np.clip(np.round(w32 / s), -7, 7).reshape(512, 128)
+        np.testing.assert_array_equal(q, ref)
+
+    def test_odd_contraction_rejected(self):
+        with pytest.raises(ValueError):
+            quantize4(jnp.ones((100, 64)))
+
+
+class TestInt4Matmul:
+    def test_xla_fallback_matches_dequant_oracle(self):
+        w = jax.random.normal(jax.random.PRNGKey(0), (2048, 512)) * 0.05
+        qt = quantize4(w)
+        x = jax.random.normal(jax.random.PRNGKey(1), (4, 2048), jnp.bfloat16)
+        oracle = (
+            x.astype(jnp.float32) @ dequantize(qt, jnp.bfloat16).astype(jnp.float32)
+        ).astype(jnp.bfloat16)
+        out = int4_mm_xla(x, qt)
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), np.asarray(oracle, np.float32),
+            atol=0.02,  # bf16 dot rounding between the two formulations
+        )
+
+    @pytest.mark.parametrize("M,K,N", [(1, 2048, 256), (33, 4096, 512)])
+    def test_kernel_matches_fallback(self, M, K, N):
+        w = jax.random.normal(jax.random.PRNGKey(0), (K, N)) * 0.05
+        qt = quantize4(w)
+        x = jax.random.normal(jax.random.PRNGKey(1), (M, K), jnp.bfloat16)
+        out_k = int4_mm(x, qt)  # interpret mode on CPU
+        out_x = int4_mm_xla(x, qt)
+        np.testing.assert_allclose(
+            np.asarray(out_k, np.float32), np.asarray(out_x, np.float32),
+            atol=5e-3,
+        )
+        import fei_tpu.ops.pallas.int4_matmul as m
+
+        assert not m._mosaic_failed  # the kernel path actually ran
+
+    def test_small_shapes_use_fallback(self):
+        """Shapes the kernel can't tile route through XLA, not an error."""
+        w = jax.random.normal(jax.random.PRNGKey(0), (512, 64)) * 0.05
+        qt = quantize4(w)
+        x = jnp.ones((2, 512), jnp.bfloat16)
+        out = mm(x, qt)
+        assert out.shape == (2, 64)
+
+    def test_mm_dispatch_3d(self):
+        w = jax.random.normal(jax.random.PRNGKey(0), (2048, 256)) * 0.05
+        qt = quantize4(w)
+        x = jax.random.normal(jax.random.PRNGKey(1), (2, 3, 2048), jnp.bfloat16)
+        assert mm(x, qt).shape == (2, 3, 256)
+
+
+class TestMixedTreeRules:
+    def test_lm_head_and_moe_experts_stay_int8(self):
+        params = {
+            "layers": {
+                "router": jnp.ones((2, 512, 8)),
+                "wq": jnp.ones((2, 512, 512)),
+                "w_gate": jnp.ones((2, 8, 512, 1024)),
+            },
+            "lm_head": jnp.ones((512, 1024)),
+        }
+        out = quantize_params(params, bits=4)
+        assert isinstance(out["layers"]["wq"], QTensor4)
+        assert isinstance(out["layers"]["w_gate"], QTensor)  # moe expert
+        assert isinstance(out["lm_head"], QTensor)
+
+    def test_ineligible_contraction_falls_back_to_int8(self):
+        params = {"layers": {"wq": jnp.ones((2, 100, 128))}}
+        out = quantize_params(params, bits=4)
+        assert isinstance(out["layers"]["wq"], QTensor)
+
+
+class TestEngineInt4:
+    def test_greedy_decode_matches_dequantized_oracle(self):
+        """The engine e2e contract: an int4 engine decodes token-identically
+        to the same weights explicitly dequantized to bf16 (h=512 so the
+        attention/mlp linears are int4-eligible)."""
+        from fei_tpu.engine import GenerationConfig, InferenceEngine
+        from fei_tpu.ops.quant import dequantize_params
+
+        kw = dict(
+            dtype=jnp.bfloat16, seed=0, tokenizer="byte", max_seq_len=64,
+            num_layers=2, hidden_size=512, intermediate_size=1024,
+            num_heads=8, num_kv_heads=4,
+        )
+        gen = GenerationConfig(max_new_tokens=12, temperature=0.0, ignore_eos=True)
+        prompt = "int4 parity probe"
+
+        eng4 = InferenceEngine.from_config("tiny", quantize="int4", **kw)
+        assert any(
+            isinstance(leaf, QTensor4)
+            for leaf in jax.tree.leaves(
+                eng4.params, is_leaf=lambda x: isinstance(x, QTensor4)
+            )
+        )
+        ids4 = eng4.generate(eng4.tokenizer.encode(prompt), gen).token_ids
+
+        eng = InferenceEngine.from_config("tiny", **kw)
+        eng.params = dequantize_params(eng4.params, jnp.bfloat16)
+        ids = eng.generate(eng.tokenizer.encode(prompt), gen).token_ids
+        assert ids4 == ids
+
+    def test_checkpoint_roundtrip_preserves_qtensor4(self, tmp_path):
+        """Orbax round-trips NamedTuples as dicts; the restore retype must
+        rebuild QTensor4 (and not confuse it with int8 QTensor)."""
+        from fei_tpu.engine.weights import restore_checkpoint, save_checkpoint
+        from fei_tpu.ops.quant import quantize as quantize8
+
+        w = jax.random.normal(jax.random.PRNGKey(0), (512, 128)) * 0.05
+        tree = {
+            "layers": {"wq": quantize4(w), "wo": quantize8(w)},
+            "norm": jnp.ones((4,)),
+        }
+        path = str(tmp_path / "ckpt")
+        save_checkpoint(tree, path)
+        back = restore_checkpoint(path)
+        assert isinstance(back["layers"]["wq"], QTensor4)
+        assert isinstance(back["layers"]["wo"], QTensor)
+        np.testing.assert_array_equal(
+            np.asarray(back["layers"]["wq"].p), np.asarray(tree["layers"]["wq"].p)
+        )
+        np.testing.assert_allclose(
+            np.asarray(back["layers"]["wq"].s), np.asarray(tree["layers"]["wq"].s)
+        )
+
+    def test_streamed_int4_load_matches_host_quant(self, tmp_path):
+        """HF-dir load with quantize='int4': eligible leaves land packed
+        and bit-identical to quantize4 of the eagerly-loaded weights;
+        lm_head stays int8; the loaded model runs close to the bf16 one."""
+        from test_streamed_load import _write_hf_llama
+
+        from fei_tpu.engine.weights import load_checkpoint
+        from fei_tpu.models.configs import get_model_config
+        from fei_tpu.models.llama import KVCache, forward
+
+        cfg = get_model_config(
+            "tiny", hidden_size=512, intermediate_size=1024,
+            num_heads=8, num_kv_heads=4,
+        )
+        _write_hf_llama(tmp_path, cfg)
+        _, eager = load_checkpoint(str(tmp_path), cfg, dtype=jnp.float32)
+        cfg2, q4 = load_checkpoint(
+            str(tmp_path), cfg, dtype=jnp.float32, quantize="int4"
+        )
+        wq = q4["layers"]["wq"]
+        assert isinstance(wq, QTensor4)
+        assert isinstance(q4["lm_head"], QTensor)
+        ref = quantize4(eager["layers"]["wq"])
+        np.testing.assert_array_equal(np.asarray(wq.p), np.asarray(ref.p))
+        np.testing.assert_allclose(
+            np.asarray(wq.s), np.asarray(ref.s), rtol=1e-6
+        )
+        # run-parity vs the dequantized oracle (mm-path correctness; the
+        # quantization ERROR itself is pinned by the roundtrip-bound test —
+        # on this test's unscaled random stack it amplifies multiplicatively
+        # and is not a meaningful accuracy statement)
+        from fei_tpu.ops.quant import dequantize_params
+
+        tokens = jnp.array([[5, 6, 7]], jnp.int32)
+        cache = KVCache.create(cfg2, 1, 8, jnp.float32)
+        logits, _ = forward(q4, cfg2, tokens, cache)
+        want, _ = forward(
+            dequantize_params(q4, jnp.float32), cfg2, tokens, cache
+        )
+        rel = np.abs(np.asarray(logits) - np.asarray(want)).max()
+        rel /= np.abs(np.asarray(want)).max()
+        assert rel < 0.03  # bf16 dot rounding between the two formulations
+
+    def test_int4_rejects_mesh(self):
+        from fei_tpu.engine import InferenceEngine
+        from fei_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh({"tp": 2}, devices=jax.devices()[:2])
+        with pytest.raises(ValueError, match="int4"):
+            InferenceEngine.from_config(
+                "tiny", quantize="int4", mesh=mesh, num_layers=1
+            )
