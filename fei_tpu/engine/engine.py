@@ -181,17 +181,16 @@ class InferenceEngine:
         v5e chip; norms/router/embed stay in ``dtype``. ``quantize="int4"``
         halves the stream again via nibble-packed QTensor4 + the Pallas
         grouped-dequant matmul (lm_head and stacked MoE experts stay int8
-        — ops.quant._int4_ok). Single-chip only (any mesh is rejected):
-        QTensor4's nibble pairing spans the contraction axis, so TP
-        sharding would split pairs across devices."""
+        — ops.quant._int4_ok). On a tp>1 mesh the row-parallel linears
+        (wo/w_down) additionally stay int8 — TP shards their contraction
+        axis, which would split nibble pairs across devices; the
+        column-parallel ones run the kernel under shard_map
+        (ops.pallas.int4_matmul.int4_mm_sharded via models.llama._mm_k)."""
         if quantize not in (None, "int8", "int4"):
             raise ValueError(f"unsupported quantize mode: {quantize!r}")
-        if quantize == "int4" and mesh is not None:
-            raise ValueError(
-                "quantize='int4' does not compose with a mesh yet (nibble "
-                "pairs span the contraction axis; TP would split them) — "
-                "use quantize='int8' for sharded serving"
-            )
+        int4_exclude = frozenset()
+        if quantize == "int4" and mesh is not None and mesh.shape.get("tp", 1) > 1:
+            int4_exclude = frozenset({"wo", "w_down"})
         cfg = get_model_config(name, **overrides)
         tok = load_tokenizer(tokenizer)
         if checkpoint_dir:
@@ -206,7 +205,8 @@ class InferenceEngine:
         else:
             # quantize-at-init keeps peak memory to one tensor's bf16 copy
             params = init_params(
-                cfg, jax.random.PRNGKey(seed), dtype=dtype, quantize=quantize
+                cfg, jax.random.PRNGKey(seed), dtype=dtype, quantize=quantize,
+                int4_exclude=int4_exclude,
             )
         engine = cls(
             cfg, params, tok,
@@ -244,10 +244,13 @@ class InferenceEngine:
             routed = self.mesh is None  # EP meshes own their routing
             moe_mesh = self._moe_mesh()
 
+            kernel_mesh = self.mesh
+
             def prefill(params, tokens, cache):
                 return forward(
                     params, cfg, tokens, cache,
                     routed_moe=routed, moe_mesh=moe_mesh,
+                    kernel_mesh=kernel_mesh,
                 )
 
             self._prefill_cache[key] = jax.jit(prefill, donate_argnums=(2,))
@@ -265,10 +268,13 @@ class InferenceEngine:
                 gen.temperature, gen.top_k, gen.top_p, gen.min_p
             )
 
+            kernel_mesh = self.mesh
+
             def step(params, cache, token, rng, logit_mask):
                 logits, cache = forward(
                     params, cfg, token, cache,
                     routed_moe=routed, moe_mesh=moe_mesh,
+                    kernel_mesh=kernel_mesh,
                 )
                 logits = logits[:, -1, :]
                 if logit_mask is not None:
@@ -295,7 +301,7 @@ class InferenceEngine:
             cfg = self.cfg
             fwd = functools.partial(
                 forward, routed_moe=self.mesh is None,
-                moe_mesh=self._moe_mesh(),
+                moe_mesh=self._moe_mesh(), kernel_mesh=self.mesh,
             )
             temperature, top_k, top_p, min_p = (
                 gen.temperature, gen.top_k, gen.top_p, gen.min_p
@@ -447,7 +453,7 @@ class InferenceEngine:
             cfg = self.cfg
             fwd = functools.partial(
                 forward, routed_moe=self.mesh is None,
-                moe_mesh=self._moe_mesh(),
+                moe_mesh=self._moe_mesh(), kernel_mesh=self.mesh,
             )
             temperature, top_k, top_p, min_p = (
                 gen.temperature, gen.top_k, gen.top_p, gen.min_p

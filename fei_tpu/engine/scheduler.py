@@ -734,7 +734,7 @@ class PagedScheduler:
                 h_last = jax.lax.dynamic_slice_in_dim(
                     hidden, last_idx, 1, axis=1
                 )  # [1, 1, H] — already final-normed (lm_head=False contract)
-                return _logits(h_last, params, cfg)[:, 0], out_pool
+                return _logits(h_last, params, cfg, kernel_mesh=mesh)[:, 0], out_pool
 
             self._pchunk_jit[key] = jax.jit(chunk, donate_argnums=(1,))
         return self._pchunk_jit[key]
@@ -834,18 +834,22 @@ class PagedScheduler:
             cfg = self.engine.cfg
             routed = self.engine.mesh is None
             moe_mesh = self.engine._moe_mesh()
+            kernel_mesh = self.engine.mesh
             from fei_tpu.models.llama import _logits
 
             def chunk(params, dense, toks, true_len):
                 hidden, cache2 = forward(
                     params, cfg, toks, dense,
                     routed_moe=routed, moe_mesh=moe_mesh, lm_head=False,
+                    kernel_mesh=kernel_mesh,
                 )
                 cache2 = cache2._replace(length=dense.length + true_len)
                 h_last = jax.lax.dynamic_slice_in_dim(
                     hidden, true_len - 1, 1, axis=1
                 )  # [1, 1, H]
-                return _logits(h_last, params, cfg)[:, 0], cache2
+                return _logits(h_last, params, cfg, kernel_mesh=kernel_mesh)[
+                    :, 0
+                ], cache2
 
             self._chunk_jit[key] = jax.jit(chunk, donate_argnums=(1,))
         return self._chunk_jit[key]

@@ -396,25 +396,109 @@ def _build_quantized(plan: _TensorPlan, sharding) -> QTensor:
     return QTensor(q=q, s=s)
 
 
-def _build_quantized4(plan: _TensorPlan):
-    """int4 QTensor4, unsharded (int4 rejects meshes at the engine). The
-    read streams per leading-axis step (layer) so host fp32 peak stays at
-    one layer's weights, mirroring _build_quantized."""
-    from fei_tpu.ops.quant import QTensor4
+def _spec_entry(spec, axis: int, rank: int):
+    """The PartitionSpec entry for ``axis`` of a rank-``rank`` array (specs
+    may be shorter than the rank; missing entries are unsharded)."""
+    entries = list(spec) + [None] * (rank - len(spec))
+    return entries[axis]
+
+
+def _build_int8_leaf(plan: _TensorPlan, shard):
+    """int8 QTensor leaf, sharded or not (the int8 scale's contraction axis
+    collapses to 1, so its spec drops that entry)."""
+    if shard is None:
+        return _build_quantized(plan, None)
+    from jax.sharding import NamedSharding
+
+    from fei_tpu.parallel.sharding import _scale_spec
+
+    s_shape = (*plan.shape[:-2], 1, plan.shape[-1])
+    s_shard = NamedSharding(shard.mesh, _scale_spec(shard.spec, s_shape))
+    return _build_quantized(plan, (shard, s_shard))
+
+
+def _build_quantized4(plan: _TensorPlan, sharding=None):
+    """int4 QTensor4. Eligibility guarantees the contraction axis is never
+    sharded, so every shard reads its full-K column slice; reads stream per
+    leading-axis step (layer) to bound host fp32 peak, and same-key
+    callbacks (p+s of one shard, replicated shards) share one read+quantize
+    via the memo — mirroring _build_quantized.
+
+    ``sharding``: None, or (p_sharding, s_sharding) NamedSharding pair."""
+    from fei_tpu.ops.quant import INT4_GROUP, QTensor4
 
     shape = plan.shape
-    full = _full(shape)
-    if len(shape) >= 3:
-        ps, ss = [], []
-        for layer in range(shape[0]):
-            idx = (slice(layer, layer + 1),) + full[1:]
-            p1, s1 = _quant4_host(plan.read(idx))
-            ps.append(p1)
-            ss.append(s1)
-        return QTensor4(p=jnp.asarray(np.concatenate(ps)),
-                        s=jnp.asarray(np.concatenate(ss)))
-    p, s = _quant4_host(plan.read(full))
-    return QTensor4(p=jnp.asarray(p), s=jnp.asarray(s))
+    K = shape[-2]
+    p_shape = (*shape[:-2], K // 2, shape[-1])
+    s_shape = (*shape[:-2], K // INT4_GROUP, shape[-1])
+    memo: dict[tuple, tuple] = {}
+    inflight: dict[tuple, threading.Event] = {}
+    lock = threading.Lock()
+
+    def compute(idx_wo_contraction):
+        widx = list(idx_wo_contraction)
+        widx.insert(len(widx) - 1, slice(0, K))
+        if len(shape) >= 3:
+            lead = idx_wo_contraction[0]
+            ps, ss = [], []
+            for layer in range(lead.start, lead.stop):
+                widx[0] = slice(layer, layer + 1)
+                p1, s1 = _quant4_host(plan.read(tuple(widx)))
+                ps.append(p1)
+                ss.append(s1)
+            return np.concatenate(ps), np.concatenate(ss)
+        return _quant4_host(plan.read(tuple(widx)))
+
+    def quant_cols(idx_wo_contraction):
+        key = tuple((sl.start, sl.stop) for sl in idx_wo_contraction)
+        with lock:
+            if key in memo:
+                return memo[key]
+            ev = inflight.get(key)
+            if ev is None:
+                inflight[key] = ev = threading.Event()
+                owner = True
+            else:
+                owner = False
+        if not owner:
+            ev.wait()
+            with lock:
+                hit = memo.get(key)
+            if hit is None:
+                raise CheckpointError(
+                    f"concurrent int4 read for slice {key} failed in owner"
+                )
+            return hit
+        try:
+            result = compute(idx_wo_contraction)
+            with lock:
+                memo[key] = result
+            return result
+        finally:
+            ev.set()
+            with lock:
+                inflight.pop(key, None)
+
+    def read_p(idx):
+        idx = _norm_idx(idx, p_shape)
+        p, _ = quant_cols(idx[:-2] + idx[-1:])
+        return p[..., idx[-2], :]
+
+    def read_s(idx):
+        idx = _norm_idx(idx, s_shape)
+        _, s = quant_cols(idx[:-2] + idx[-1:])
+        return s[..., idx[-2], :]
+
+    if sharding is None:
+        full = _full(shape)
+        p, s = quant_cols(full[:-2] + full[-1:])
+        return QTensor4(p=jnp.asarray(p), s=jnp.asarray(s))
+
+    p_shard, s_shard = sharding
+    return QTensor4(
+        p=jax.make_array_from_callback(p_shape, p_shard, read_p),
+        s=jax.make_array_from_callback(s_shape, s_shard, read_s),
+    )
 
 
 def load_checkpoint(
@@ -441,16 +525,12 @@ def load_checkpoint(
     ``quantize="int8"``: big linear weights land as ops.quant.QTensor.
     ``quantize="int4"``: int4-eligible leaves (ops.quant._int4_ok: not
     lm_head, not stacked MoE experts, contraction divisible by 256) land as
-    QTensor4; the rest as int8 QTensor. Unsharded only (the engine rejects
-    int4 + mesh: nibble pairs span the contraction axis).
+    QTensor4; the rest — including any leaf whose sharding spec splits the
+    contraction axis (row-parallel wo/w_down under tp) — as int8 QTensor,
+    since nibble pairs span the contraction axis.
     """
     if quantize not in (None, "int8", "int4"):
         raise CheckpointError(f"unsupported quantize mode: {quantize!r}")
-    if quantize == "int4" and (shardings is not None or mesh is not None):
-        raise CheckpointError(
-            "quantize='int4' does not compose with sharded loading — "
-            "use quantize='int8' for sharded serving"
-        )
     cfg = _merge_hf_config(ckpt_dir, cfg)
     if shardings is None and mesh is not None:
         from fei_tpu.parallel.sharding import param_shardings_from_cfg
@@ -466,24 +546,29 @@ def load_checkpoint(
         if quantize == "int4" and key in QUANT_KEYS:
             from fei_tpu.ops.quant import _int4_ok
 
+            contract_sharded = shard is not None and _spec_entry(
+                shard.spec, len(plan.shape) - 2, len(plan.shape)
+            ) is not None
             # _int4_ok only reads .shape[-2]; a plan quacks enough
-            leaf = (
-                _build_quantized4(plan)
-                if _int4_ok(key, plan, cfg.is_moe)
-                else _build_quantized(plan, None)
-            )
-        elif quantize == "int8" and key in QUANT_KEYS:
-            if shard is not None:
-                from fei_tpu.parallel.sharding import _scale_spec
-                from jax.sharding import NamedSharding
+            if _int4_ok(key, plan, cfg.is_moe) and not contract_sharded:
+                if shard is not None:
+                    from fei_tpu.parallel.sharding import _q4_specs
+                    from jax.sharding import NamedSharding
 
-                s_shape = (*plan.shape[:-2], 1, plan.shape[-1])
-                s_shard = NamedSharding(
-                    shard.mesh, _scale_spec(shard.spec, s_shape)
-                )
-                leaf = _build_quantized(plan, (shard, s_shard))
+                    p_spec, s_spec = _q4_specs(shard.spec, len(plan.shape))
+                    leaf = _build_quantized4(
+                        plan,
+                        (
+                            NamedSharding(shard.mesh, p_spec),
+                            NamedSharding(shard.mesh, s_spec),
+                        ),
+                    )
+                else:
+                    leaf = _build_quantized4(plan)
             else:
-                leaf = _build_quantized(plan, None)
+                leaf = _build_int8_leaf(plan, shard)
+        elif quantize == "int8" and key in QUANT_KEYS:
+            leaf = _build_int8_leaf(plan, shard)
         else:
             leaf = _build_plain(plan, dtype, shard)
         if path[0] == "layers":

@@ -56,7 +56,11 @@ _INIT_BUILDERS: dict = {}  # (repr(cfg), str(dtype), quantize) -> jitted builder
 
 
 def init_params(
-    cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16, quantize: str | None = None
+    cfg: ModelConfig,
+    key: jax.Array,
+    dtype=jnp.bfloat16,
+    quantize: str | None = None,
+    int4_exclude: frozenset = frozenset(),
 ) -> dict:
     """Random-init parameter pytree (layers stacked on axis 0).
 
@@ -71,7 +75,12 @@ def init_params(
     (an 8B random-init would otherwise risk ~16 GB of simultaneous bf16
     before the quantize consumers run). Builders are cached per
     (config, dtype, quantize) so repeated inits hit the compile cache."""
-    cache_key = (repr(cfg), str(dtype), quantize)
+    # FEI_TPU_INT4_LM_HEAD changes _int4_ok's trace-time answer, so it must
+    # key the builder cache or a flip mid-process would reuse a stale layout
+    cache_key = (
+        repr(cfg), str(dtype), quantize, tuple(sorted(int4_exclude)),
+        os.environ.get("FEI_TPU_INT4_LM_HEAD"),
+    )
     built = _INIT_BUILDERS.get(cache_key)
     if built is not None:
         return built(key)
@@ -91,7 +100,11 @@ def init_params(
                 jax.random.normal(k, shape, dtype=jnp.float32) * (fan_in ** -0.5)
             ).astype(dtype)
             if quant and quantize:
-                if quantize == "int4" and _int4_ok(name, w, cfg.is_moe):
+                if (
+                    quantize == "int4"
+                    and name not in int4_exclude
+                    and _int4_ok(name, w, cfg.is_moe)
+                ):
                     w = _quantize4_w(w)
                 else:  # int8, and the int4 mode's int8-kept leaves
                     w = _quantize_w(w)
@@ -192,11 +205,33 @@ def _moe(cfg: ModelConfig, y, lp, allow_routed: bool, moe_mesh=None):
     return fn(*args)
 
 
-def qkv_proj(lp, y, Hq: int, K: int, d: int):
+def _mm_k(x, w, kernel_mesh):
+    """mm that routes int4 leaves through the shard_map'd kernel under a
+    tp mesh. XLA auto-partitions plain dots and int8 QTensor dots, but not
+    a pallas_call — a global-view QTensor4 matmul would all-gather the full
+    packed weight to every device. Only out-channel-sharded weights can be
+    QTensor4 on a mesh (eligibility keeps row-parallel wo/w_down int8), so
+    the column-parallel shard_map contract always applies."""
+    from fei_tpu.ops.quant import QTensor4
+
+    if (
+        kernel_mesh is not None
+        and isinstance(w, QTensor4)
+        and kernel_mesh.shape.get("tp", 1) > 1
+    ):
+        from fei_tpu.ops.pallas.int4_matmul import int4_mm_sharded
+
+        return int4_mm_sharded(x, w, kernel_mesh)
+    return mm(x, w)
+
+
+def qkv_proj(lp, y, Hq: int, K: int, d: int, kernel_mesh=None):
     """Project y -> (q [B,T,Hq,d], k [B,T,K,d], v [B,T,K,d]), applying the
     Qwen2-style qkv biases when the layer carries them (cfg.attn_bias)."""
     B, T, _ = y.shape
-    q, k, v = mm(y, lp["wq"]), mm(y, lp["wk"]), mm(y, lp["wv"])
+    q = _mm_k(y, lp["wq"], kernel_mesh)
+    k = _mm_k(y, lp["wk"], kernel_mesh)
+    v = _mm_k(y, lp["wv"], kernel_mesh)
     if "bq" in lp:
         q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
     return (
@@ -228,7 +263,7 @@ def _attend(q, k, v, kv_length, positions):
 
 def _layer(
     cfg: ModelConfig, x, lp, cache_k, cache_v, kv_length, positions, cos, sin,
-    allow_routed: bool = False, moe_mesh=None,
+    allow_routed: bool = False, moe_mesh=None, kernel_mesh=None,
 ):
     """One decoder block. x: [B,T,H]; cache_k/v: [B,S,K,D] (this layer's
     slice) or None for the cache-free training path.
@@ -238,7 +273,7 @@ def _layer(
     Hq = cfg.num_heads
 
     y = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
-    q, k, v = qkv_proj(lp, y, Hq, K, d)
+    q, k, v = qkv_proj(lp, y, Hq, K, d, kernel_mesh=kernel_mesh)
     q = apply_rope(q, cos, sin, positions)
     k = apply_rope(k, cos, sin, positions)
 
@@ -262,16 +297,18 @@ def _layer(
     if cfg.is_moe:
         mlp_out = _moe(cfg, y, lp, allow_routed, moe_mesh)
     else:
-        act = jax.nn.silu(mm(y, lp["w_gate"]).astype(jnp.float32)).astype(y.dtype)
-        mlp_out = mm(act * mm(y, lp["w_up"]), lp["w_down"])
+        act = jax.nn.silu(
+            _mm_k(y, lp["w_gate"], kernel_mesh).astype(jnp.float32)
+        ).astype(y.dtype)
+        mlp_out = mm(act * _mm_k(y, lp["w_up"], kernel_mesh), lp["w_down"])
     return x + mlp_out, new_k, new_v
 
 
-def _logits(x, params, cfg: ModelConfig) -> jnp.ndarray:
+def _logits(x, params, cfg: ModelConfig, kernel_mesh=None) -> jnp.ndarray:
     """LM head (quantization-aware); tied embeddings stay bf16."""
     if cfg.tie_embeddings:
         return (x @ params["embed"].T.astype(x.dtype)).astype(jnp.float32)
-    return mm(x, params["lm_head"]).astype(jnp.float32)
+    return _mm_k(x, params["lm_head"], kernel_mesh).astype(jnp.float32)
 
 
 def forward(
@@ -282,6 +319,7 @@ def forward(
     routed_moe: bool = False,
     moe_mesh=None,
     lm_head: bool = True,
+    kernel_mesh=None,
 ) -> tuple[jnp.ndarray, KVCache]:
     """Run T tokens through the model against the cache.
 
@@ -290,6 +328,8 @@ def forward(
     ``lm_head=False`` returns final-norm hidden states [B, T, H] instead of
     logits — chunked prefill only needs one position's logits, so callers
     skip the [T, V] head matmul and project the position they want.
+    ``kernel_mesh``: a mesh with a tp axis routes int4 (QTensor4) linears
+    through the shard_map'd kernel (see _mm_k).
     """
     B, T = tokens.shape
     positions = cache.length[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
@@ -303,6 +343,7 @@ def forward(
         x, nk, nv = _layer(
             cfg, x, lp, ck, cv, cache.length, positions, cos, sin,
             allow_routed=routed_moe, moe_mesh=moe_mesh,
+            kernel_mesh=kernel_mesh,
         )
         return x, (nk, nv)
 
@@ -314,7 +355,7 @@ def forward(
     new_cache = KVCache(k=new_k, v=new_v, length=cache.length + T)
     if not lm_head:
         return x, new_cache
-    return _logits(x, params, cfg), new_cache
+    return _logits(x, params, cfg, kernel_mesh=kernel_mesh), new_cache
 
 
 def forward_paged(
@@ -402,7 +443,7 @@ def forward_paged_block(
             lp, kp, vp = layer_inputs
             ksc = vsc = None
         y = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
-        q, k, v = qkv_proj(lp, y, Hq, K, d)
+        q, k, v = qkv_proj(lp, y, Hq, K, d, kernel_mesh=kernel_mesh)
         q = apply_rope(q, cos, sin, positions)
         k = apply_rope(k, cos, sin, positions)
 
@@ -453,8 +494,10 @@ def forward_paged_block(
         if cfg.is_moe:
             mlp_out = _moe(cfg, y, lp, routed_moe, moe_mesh)
         else:
-            act = jax.nn.silu(mm(y, lp["w_gate"]).astype(jnp.float32)).astype(y.dtype)
-            mlp_out = mm(act * mm(y, lp["w_up"]), lp["w_down"])
+            act = jax.nn.silu(
+                _mm_k(y, lp["w_gate"], kernel_mesh).astype(jnp.float32)
+            ).astype(y.dtype)
+            mlp_out = mm(act * _mm_k(y, lp["w_up"], kernel_mesh), lp["w_down"])
         out = (kp, vp, ksc, vsc) if kv_int8 else (kp, vp)
         return x + mlp_out, out
 
@@ -470,7 +513,7 @@ def forward_paged_block(
         new_ks = new_vs = None
 
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
-    out = _logits(x, params, cfg) if lm_head else x
+    out = _logits(x, params, cfg, kernel_mesh=kernel_mesh) if lm_head else x
     new_cache = cache._replace(
         k_pages=new_k, v_pages=new_v, lengths=cache.lengths + T,
         k_scales=new_ks, v_scales=new_vs,

@@ -51,8 +51,8 @@ _BLOCK_N = 256
 # with gs=128 that means a multiple of 1024. Chosen per-shape below.
 _BLOCK_K2_CANDIDATES = (4096, 2048, 1024)
 
-_mosaic_failed = False  # one-time auto-fallback latch (per process)
-_mosaic_probed = False
+_mosaic_failed = False  # blanket auto-fallback latch (per process)
+_mosaic_probe_cache: dict[tuple, bool] = {}  # per-(bm,bn,bk2,gs) preflight
 
 
 def _kernel(x1_ref, x2_ref, p_ref, slo_ref, shi_ref, o_ref, acc_ref, *, nk: int):
@@ -166,35 +166,83 @@ def _pick_blocks(K2: int, N: int, gs: int) -> tuple[int, int] | None:
     return (bk2, bn) if bk2 and bn else None
 
 
-def _mosaic_ok() -> bool:
-    """One-time Mosaic preflight: eagerly compile a minimal representative
-    kernel instance OUTSIDE any enclosing jit. int4_mm is usually traced
-    inside the engine's jitted prefill/decode programs, where pallas_call
-    only *traces* — Mosaic compilation happens later at outer-jit compile
-    time, outside any try/except here. This probe is ordinary Python at
-    trace time, so a Mosaic rejection latches the fallback instead of
-    crashing the engine's compiled-call site."""
-    global _mosaic_failed, _mosaic_probed
-    if _mosaic_probed:
-        return not _mosaic_failed
-    _mosaic_probed = True
+def _mosaic_ok(block_m: int, block_n: int, block_k2: int, gs: int) -> bool:
+    """Per-block-config Mosaic preflight: eagerly compile a one-block
+    kernel instance with EXACTLY the requested block shapes OUTSIDE any
+    enclosing jit. int4_mm is usually traced inside the engine's jitted
+    prefill/decode programs, where pallas_call only *traces* — Mosaic
+    compilation happens later at outer-jit compile time, outside any
+    try/except here. The probe is ordinary Python at trace time, so a
+    Mosaic rejection (VMEM overflow at large blocks, a layout restriction
+    at a particular tiling) latches the fallback for that config instead
+    of crashing the engine's compiled-call site. Probing the exact
+    (bm, bn, bk2, gs) matters: a minimal shape compiling says nothing
+    about a 4096-row block's VMEM footprint."""
+    global _mosaic_failed
+    if _mosaic_failed:
+        return False
     if jax.default_backend() != "tpu":
         return True  # interpret mode: no Mosaic involved
+    key = (block_m, block_n, block_k2, gs)
+    hit = _mosaic_probe_cache.get(key)
+    if hit is not None:
+        return hit
     try:
-        gs = 128
-        x = jnp.zeros((8, 2 * 8 * gs), jnp.bfloat16)
-        p = jnp.zeros((8 * gs, 128), jnp.int8)
-        s = jnp.zeros((16, 128), jnp.float32)
+        x = jnp.zeros((block_m, 2 * block_k2), jnp.bfloat16)
+        p = jnp.zeros((block_k2, block_n), jnp.int8)
+        s = jnp.zeros((2 * block_k2 // gs, block_n), jnp.float32)
         _int4_mm_kernel(
-            x, p, s, block_m=8, block_n=128, block_k2=8 * gs, interpret=False
+            x, p, s, block_m=block_m, block_n=block_n, block_k2=block_k2,
+            interpret=False,
         ).block_until_ready()
+        _mosaic_probe_cache[key] = True
     except Exception as e:
-        _mosaic_failed = True
+        _mosaic_probe_cache[key] = False
         log.warning(
-            "int4 Pallas kernel failed Mosaic preflight (%s); all int4 "
-            "matmuls use the XLA fallback", e,
+            "int4 Pallas kernel failed Mosaic preflight for blocks %s (%s); "
+            "this config uses the XLA fallback", key, e,
         )
-    return not _mosaic_failed
+    return _mosaic_probe_cache[key]
+
+
+def int4_mm_sharded(
+    x: jnp.ndarray, w: QTensor4, mesh, axis_name: str = "tp"
+) -> jnp.ndarray:
+    """Tensor-parallel int4 matmul for OUT-channel-sharded weights.
+
+    XLA cannot auto-partition a pallas_call — under a mesh the global-view
+    kernel would all-gather the full packed weight to every device (13
+    collectives measured on a tp=2 probe). Same fix as the paged kernels
+    (_sharded_paged): shard_map over the tp axis, each device running the
+    fused kernel on its local N-shard. The Megatron column-parallel
+    contract holds: x replicates over tp, out is N-sharded. The batch dim
+    rides dp when it divides (mirroring cache_shardings' conditional).
+
+    Contract-axis-sharded weights (row-parallel wo/w_down) must not be
+    QTensor4 at all — eligibility keeps them int8 (nibble pairs span K).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    batch_axis = (
+        "dp"
+        if "dp" in mesh.axis_names
+        and mesh.shape["dp"] > 1
+        and x.shape[0] % mesh.shape["dp"] == 0
+        else None
+    )
+    x_spec = P(batch_axis, *([None] * (x.ndim - 1)))
+    w_spec = P(None, axis_name)
+    out_spec = P(batch_axis, *([None] * (x.ndim - 2)), axis_name)
+
+    def body(x_loc, p_loc, s_loc):  # names must not shadow the pallas `pl`
+        return int4_mm(x_loc, QTensor4(p=p_loc, s=s_loc))
+
+    fn = jax.shard_map(
+        body, mesh=mesh, in_specs=(x_spec, w_spec, w_spec),
+        out_specs=out_spec,
+        check_vma=False,  # the vma checker can't see through a pallas_call
+    )
+    return fn(x, w.p, w.s)
 
 
 def int4_mm(x: jnp.ndarray, w: QTensor4) -> jnp.ndarray:
@@ -216,7 +264,7 @@ def int4_mm(x: jnp.ndarray, w: QTensor4) -> jnp.ndarray:
 
     blocks = (
         _pick_blocks(K2, N, w.group_size)
-        if os.environ.get("FEI_TPU_INT4_KERNEL", "1") != "0" and _mosaic_ok()
+        if os.environ.get("FEI_TPU_INT4_KERNEL", "1") != "0"
         else None
     )
     if blocks is None:
@@ -226,6 +274,8 @@ def int4_mm(x: jnp.ndarray, w: QTensor4) -> jnp.ndarray:
     x2d = x.reshape(-1, K)
     M = x2d.shape[0]
     block_m = min(_BLOCK_M, max(8, -(-M // 8) * 8))
+    if not _mosaic_ok(block_m, block_n, block_k2, w.group_size):
+        return int4_mm_xla(x, w)
     Mp = -(-M // block_m) * block_m
     if Mp != M:
         x2d = jnp.pad(x2d, ((0, Mp - M), (0, 0)))

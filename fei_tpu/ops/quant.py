@@ -202,13 +202,19 @@ def scale_rows(out: jnp.ndarray, w, expert_ids: jnp.ndarray) -> jnp.ndarray:
 def _int4_ok(key: str, w, moe: bool) -> bool:
     """Whether a big-linear leaf takes int4 in mixed int4/int8 mode.
 
-    lm_head stays int8 (the final projection is the most scale-sensitive
-    linear — standard GPTQ/AWQ practice) and stacked MoE experts stay int8
-    (the einsum/ragged-dot expert paths consume raw int8 planes via wcast;
-    a nibble-packed operand has no ragged_dot formulation). Both still
-    halve bf16; everything else halves again.
+    lm_head stays int8 by default (the final projection is the most
+    scale-sensitive linear — standard GPTQ/AWQ practice;
+    FEI_TPU_INT4_LM_HEAD=1 opts it in for another ~6% off the 8B stream)
+    and stacked MoE experts stay int8 (the einsum/ragged-dot expert paths
+    consume raw int8 planes via wcast; a nibble-packed operand has no
+    ragged_dot formulation). Both still halve bf16; everything else halves
+    again.
     """
-    if key == "lm_head" or (moe and key in ("w_gate", "w_up", "w_down")):
+    import os
+
+    if key == "lm_head" and os.environ.get("FEI_TPU_INT4_LM_HEAD") != "1":
+        return False
+    if moe and key in ("w_gate", "w_up", "w_down"):
         return False
     return w.shape[-2] % (2 * INT4_GROUP) == 0
 

@@ -142,7 +142,10 @@ def prefill_ring_kv(
             x, jnp.broadcast_to(idx, (B, 1, x.shape[-1])), axis=1
         )[:, 0, :]
     last = rms_norm(last, params["final_norm"], cfg.rms_norm_eps)
-    logits = _logits(last, params, cfg)
+    # kernel_mesh: on an sp+tp mesh a QTensor4 lm_head must route through
+    # the shard_map'd kernel (_mm_k checks for a real tp axis; sp-only
+    # meshes fall through to the local path)
+    logits = _logits(last, params, cfg, kernel_mesh=mesh)
     return logits, k_all, v_all
 
 

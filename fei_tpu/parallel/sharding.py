@@ -72,12 +72,28 @@ def _scale_spec(spec: P, s_shape: tuple) -> P:
     ])
 
 
+def _q4_specs(spec: P, rank: int) -> tuple[P, P]:
+    """(packed, scale) specs for a QTensor4 from its weight spec. The
+    contraction axis (-2: packed nibble rows / scale groups) must not be
+    sharded — nibble pairs span it (engine eligibility keeps row-parallel
+    weights int8, so a sharded -2 here is a caller bug, not a layout)."""
+    entries = list(spec) + [None] * (rank - len(spec))
+    if entries[-2] is not None:
+        raise ValueError(
+            f"QTensor4 cannot shard its contraction axis (spec {spec}); "
+            "int4 eligibility must keep contraction-sharded weights int8"
+        )
+    return P(*entries), P(*entries)
+
+
 def _tree_shardings(specs: dict, params: dict, mesh: Mesh) -> dict:
     """Match the spec tree to the actual param tree (lm_head may be absent).
 
     Weight-only-int8 leaves (ops.quant.QTensor) get the weight's spec on the
-    int8 tensor and a contraction-axis-collapsed spec on the scale."""
-    from fei_tpu.ops.quant import QTensor
+    int8 tensor and a contraction-axis-collapsed spec on the scale;
+    QTensor4 leaves shard packed bytes and grouped scales identically
+    (out-channel axis only)."""
+    from fei_tpu.ops.quant import QTensor, QTensor4
 
     def pick(spec_subtree, param_subtree):
         if isinstance(param_subtree, dict):
@@ -90,6 +106,12 @@ def _tree_shardings(specs: dict, params: dict, mesh: Mesh) -> dict:
                 s=NamedSharding(
                     mesh, _scale_spec(spec_subtree, param_subtree.s.shape)
                 ),
+            )
+        if isinstance(param_subtree, QTensor4):
+            p_spec, s_spec = _q4_specs(spec_subtree, param_subtree.p.ndim)
+            return QTensor4(
+                p=NamedSharding(mesh, p_spec),
+                s=NamedSharding(mesh, s_spec),
             )
         return NamedSharding(mesh, spec_subtree)
 

@@ -84,6 +84,16 @@ stage bench_moe env FEI_TPU_BENCH_SUITE=moe FEI_TPU_BENCH_MAX_WAIT_S=300 \
 stage bench_paged_kv8 env FEI_TPU_BENCH_SUITE=paged FEI_TPU_BENCH_KV_QUANT=int8 \
   FEI_TPU_BENCH_MAX_WAIT_S=300 python -u bench.py
 
+# 7. agent suite: end-to-end `fei --message` through the whole stack
+stage bench_agent env FEI_TPU_BENCH_SUITE=agent FEI_TPU_BENCH_MAX_WAIT_S=300 \
+  python -u bench.py
+
+# 8. int4 kernel on-chip + the 8B int4 decode variant (round 3+)
+stage int4_tests env FEI_TPU_TEST_PLATFORM=tpu python -m pytest \
+  tests/test_int4.py -q
+stage bench_8b_int4 env FEI_TPU_BENCH_QUANT=int4 FEI_TPU_BENCH_MAX_WAIT_S=300 \
+  python -u bench.py
+
 echo "=== pipeline done $(date -u) ===" >> "$OUT/pipeline.log"
 report
 touch "$OUT/DONE"
