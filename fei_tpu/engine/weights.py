@@ -619,7 +619,25 @@ def _merge_hf_config(ckpt_dir: str, cfg: ModelConfig) -> ModelConfig:
             False if hf.get("model_type") == "qwen2"
             else hf.get("attention_bias")
         ),
+        head_dim=hf.get("head_dim"),
     )
+    if hf.get("model_type") == "gemma":
+        # Gemma: zero-centered norm weights ((1+w) multiply), sqrt(h)-scaled
+        # embeddings, GeGLU. HF spells the activation hidden_activation
+        # (gelu_pytorch_tanh) on newer configs, hidden_act (gelu) on older
+        # ones; the modeling code always runs the tanh approximation.
+        act = hf.get("hidden_activation") or hf.get("hidden_act") or "gelu"
+        fields.update(
+            norm_offset=True,
+            embed_scale=True,
+            hidden_act="gelu" if "gelu" in act else act,
+            # GemmaConfig defaults tie_word_embeddings=True and the saved
+            # config.json omits class defaults — absent means tied here
+            tie_embeddings=(
+                True if hf.get("tie_word_embeddings") is None
+                else hf["tie_word_embeddings"]
+            ),
+        )
     fields = {k: v for k, v in fields.items() if v is not None}
     return replace(cfg, **fields)
 

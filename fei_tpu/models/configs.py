@@ -33,6 +33,11 @@ class ModelConfig:
     attn_bias: bool = False
     # HF Llama-family `attention_bias: true` additionally biases o_proj
     o_bias: bool = False
+    # Gemma family: RMSNorm multiplies by (1 + w) (weights stored
+    # zero-centered), embeddings scale by sqrt(hidden_size), GeGLU MLP
+    norm_offset: bool = False
+    embed_scale: bool = False
+    hidden_act: str = "silu"  # "silu" (SwiGLU) | "gelu" (GeGLU, tanh approx)
     # tokenizer/bos/eos defaults (overridden by a real tokenizer when loaded)
     bos_token_id: int = 1
     eos_token_id: int = 2
@@ -150,6 +155,29 @@ MODEL_CONFIGS: dict[str, ModelConfig] = {
         name="tiny-bias", vocab_size=512, hidden_size=64,
         intermediate_size=128, num_layers=2, num_heads=4, num_kv_heads=2,
         max_seq_len=2048, attn_bias=True,
+    ),
+    # Gemma family (norm offset, GeGLU, scaled embeddings, head_dim 256,
+    # always-tied embeddings, rope 10000)
+    "tiny-gemma": ModelConfig(
+        name="tiny-gemma", vocab_size=512, hidden_size=64,
+        intermediate_size=128, num_layers=2, num_heads=4, num_kv_heads=2,
+        head_dim=32, max_seq_len=2048, tie_embeddings=True,
+        norm_offset=True, embed_scale=True, hidden_act="gelu",
+        rope_theta=10000.0,
+    ),
+    "gemma-2b": ModelConfig(
+        name="gemma-2b", vocab_size=256000, hidden_size=2048,
+        intermediate_size=16384, num_layers=18, num_heads=8, num_kv_heads=1,
+        head_dim=256, rope_theta=10000.0, max_seq_len=8192,
+        tie_embeddings=True, norm_offset=True, embed_scale=True,
+        hidden_act="gelu", bos_token_id=2, eos_token_id=1,
+    ),
+    "gemma-7b": ModelConfig(
+        name="gemma-7b", vocab_size=256000, hidden_size=3072,
+        intermediate_size=24576, num_layers=28, num_heads=16, num_kv_heads=16,
+        head_dim=256, rope_theta=10000.0, max_seq_len=8192,
+        tie_embeddings=True, norm_offset=True, embed_scale=True,
+        hidden_act="gelu", bos_token_id=2, eos_token_id=1,
     ),
     "qwen2-0.5b": ModelConfig(
         name="qwen2-0.5b", vocab_size=151936, hidden_size=896,

@@ -111,13 +111,15 @@ def init_params(
             prev = w.q if hasattr(w, "q") else (w.p if hasattr(w, "p") else w)
             return w
 
+        # Gemma-family norms multiply by (1 + w): identity init is zeros
+        ninit = jnp.zeros if cfg.norm_offset else jnp.ones
         layers: dict = {
-            "attn_norm": jnp.ones((L, h), dtype=dtype),
+            "attn_norm": ninit((L, h), dtype=dtype),
             "wq": init(next(keys), (L, h, H * d), h, quant=True, name="wq"),
             "wk": init(next(keys), (L, h, K * d), h, quant=True, name="wk"),
             "wv": init(next(keys), (L, h, K * d), h, quant=True, name="wv"),
             "wo": init(next(keys), (L, H * d, h), H * d, quant=True, name="wo"),
-            "mlp_norm": jnp.ones((L, h), dtype=dtype),
+            "mlp_norm": ninit((L, h), dtype=dtype),
         }
         if cfg.attn_bias:  # Qwen2-style qkv biases
             layers.update(
@@ -144,7 +146,7 @@ def init_params(
         params = {
             "embed": init(next(keys), (cfg.vocab_size, h), h),
             "layers": layers,
-            "final_norm": jnp.ones((h,), dtype=dtype),
+            "final_norm": ninit((h,), dtype=dtype),
         }
         if not cfg.tie_embeddings:
             params["lm_head"] = init(
@@ -203,6 +205,28 @@ def _moe(cfg: ModelConfig, y, lp, allow_routed: bool, moe_mesh=None):
     )
     fn = moe_mlp_routed if use_routed else moe_mlp
     return fn(*args)
+
+
+def _norm(x, w, cfg: ModelConfig):
+    return rms_norm(x, w, cfg.rms_norm_eps, offset=cfg.norm_offset)
+
+
+def _mlp_act(cfg: ModelConfig, gate):
+    """Gated-MLP activation on the fp32-cast gate: SwiGLU (silu) for the
+    Llama/Qwen/Mixtral families, GeGLU (tanh-approx gelu — HF Gemma's
+    gelu_pytorch_tanh) for Gemma."""
+    if cfg.hidden_act == "gelu":
+        return jax.nn.gelu(gate, approximate=True)
+    return jax.nn.silu(gate)
+
+
+def embed_tokens(params: dict, cfg: ModelConfig, tokens, dtype):
+    """Embedding lookup; Gemma scales by sqrt(hidden_size) (in the compute
+    dtype, matching HF's normalizer cast)."""
+    x = params["embed"][tokens].astype(dtype)
+    if cfg.embed_scale:
+        x = x * jnp.asarray(cfg.hidden_size ** 0.5, dtype)
+    return x
 
 
 def _mm_k(x, w, kernel_mesh):
@@ -272,7 +296,7 @@ def _layer(
     K, d = cfg.num_kv_heads, cfg.head_dim_
     Hq = cfg.num_heads
 
-    y = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+    y = _norm(x, lp["attn_norm"], cfg)
     q, k, v = qkv_proj(lp, y, Hq, K, d, kernel_mesh=kernel_mesh)
     q = apply_rope(q, cos, sin, positions)
     k = apply_rope(k, cos, sin, positions)
@@ -293,12 +317,12 @@ def _layer(
         o = o + lp["bo"]
     x = x + o
 
-    y = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+    y = _norm(x, lp["mlp_norm"], cfg)
     if cfg.is_moe:
         mlp_out = _moe(cfg, y, lp, allow_routed, moe_mesh)
     else:
-        act = jax.nn.silu(
-            _mm_k(y, lp["w_gate"], kernel_mesh).astype(jnp.float32)
+        act = _mlp_act(
+            cfg, _mm_k(y, lp["w_gate"], kernel_mesh).astype(jnp.float32)
         ).astype(y.dtype)
         mlp_out = mm(act * _mm_k(y, lp["w_up"], kernel_mesh), lp["w_down"])
     return x + mlp_out, new_k, new_v
@@ -335,7 +359,7 @@ def forward(
     positions = cache.length[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
     cos, sin = compute_rope_freqs(cfg.head_dim_, cache.k.shape[2], cfg.rope_theta)
 
-    x = params["embed"][tokens].astype(cache.k.dtype)
+    x = embed_tokens(params, cfg, tokens, cache.k.dtype)
 
     def body(carry, layer_inputs):
         x = carry
@@ -351,7 +375,7 @@ def forward(
         body, x, (params["layers"], cache.k, cache.v)
     )
 
-    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    x = _norm(x, params["final_norm"], cfg)
     new_cache = KVCache(k=new_k, v=new_v, length=cache.length + T)
     if not lm_head:
         return x, new_cache
@@ -434,7 +458,7 @@ def forward_paged_block(
 
     kv_int8 = cache.k_scales is not None
     dtype = params["embed"].dtype if kv_int8 else cache.k_pages.dtype
-    x = params["embed"][tokens].astype(dtype)  # [B, T, h]
+    x = embed_tokens(params, cfg, tokens, dtype)  # [B, T, h]
 
     def body(x, layer_inputs):
         if kv_int8:
@@ -442,7 +466,7 @@ def forward_paged_block(
         else:
             lp, kp, vp = layer_inputs
             ksc = vsc = None
-        y = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+        y = _norm(x, lp["attn_norm"], cfg)
         q, k, v = qkv_proj(lp, y, Hq, K, d, kernel_mesh=kernel_mesh)
         q = apply_rope(q, cos, sin, positions)
         k = apply_rope(k, cos, sin, positions)
@@ -490,12 +514,12 @@ def forward_paged_block(
             o = o + lp["bo"]
         x = x + o
 
-        y = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+        y = _norm(x, lp["mlp_norm"], cfg)
         if cfg.is_moe:
             mlp_out = _moe(cfg, y, lp, routed_moe, moe_mesh)
         else:
-            act = jax.nn.silu(
-                _mm_k(y, lp["w_gate"], kernel_mesh).astype(jnp.float32)
+            act = _mlp_act(
+                cfg, _mm_k(y, lp["w_gate"], kernel_mesh).astype(jnp.float32)
             ).astype(y.dtype)
             mlp_out = mm(act * _mm_k(y, lp["w_up"], kernel_mesh), lp["w_down"])
         out = (kp, vp, ksc, vsc) if kv_int8 else (kp, vp)
@@ -512,7 +536,7 @@ def forward_paged_block(
         x, (new_k, new_v) = jax.lax.scan(body, x, xs)
         new_ks = new_vs = None
 
-    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    x = _norm(x, params["final_norm"], cfg)
     out = _logits(x, params, cfg, kernel_mesh=kernel_mesh) if lm_head else x
     new_cache = cache._replace(
         k_pages=new_k, v_pages=new_v, lengths=cache.lengths + T,
@@ -536,7 +560,7 @@ def forward_train(
     kv_length = jnp.zeros((B,), dtype=jnp.int32)
 
     dtype = params["embed"].dtype
-    x = params["embed"][tokens].astype(dtype)
+    x = embed_tokens(params, cfg, tokens, dtype)
 
     def body(x, lp):
         x, _, _ = _layer(cfg, x, lp, None, None, kv_length, positions, cos, sin)
@@ -546,5 +570,5 @@ def forward_train(
         body = jax.checkpoint(body)
     x, _ = jax.lax.scan(body, x, params["layers"])
 
-    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    x = _norm(x, params["final_norm"], cfg)
     return _logits(x, params, cfg)

@@ -51,8 +51,8 @@ _BLOCK_N = 256
 # with gs=128 that means a multiple of 1024. Chosen per-shape below.
 _BLOCK_K2_CANDIDATES = (4096, 2048, 1024)
 
-_mosaic_failed = False  # blanket auto-fallback latch (per process)
 _mosaic_probe_cache: dict[tuple, bool] = {}  # per-(bm,bn,bk2,gs) preflight
+_kernel_invocations = 0  # fused-kernel dispatches (tests pin kernel vs fallback)
 
 
 def _kernel(x1_ref, x2_ref, p_ref, slo_ref, shi_ref, o_ref, acc_ref, *, nk: int):
@@ -178,9 +178,6 @@ def _mosaic_ok(block_m: int, block_n: int, block_k2: int, gs: int) -> bool:
     of crashing the engine's compiled-call site. Probing the exact
     (bm, bn, bk2, gs) matters: a minimal shape compiling says nothing
     about a 4096-row block's VMEM footprint."""
-    global _mosaic_failed
-    if _mosaic_failed:
-        return False
     if jax.default_backend() != "tpu":
         return True  # interpret mode: no Mosaic involved
     key = (block_m, block_n, block_k2, gs)
@@ -252,7 +249,7 @@ def int4_mm(x: jnp.ndarray, w: QTensor4) -> jnp.ndarray:
     otherwise (odd shapes, FEI_TPU_INT4_KERNEL=0, or a failed Mosaic
     preflight).
     """
-    global _mosaic_failed
+    global _kernel_invocations
     if w.p.ndim != 2:
         raise ValueError(
             f"int4_mm expects a per-layer [K/2, N] QTensor4, got {w.p.shape}"
@@ -276,6 +273,7 @@ def int4_mm(x: jnp.ndarray, w: QTensor4) -> jnp.ndarray:
     block_m = min(_BLOCK_M, max(8, -(-M // 8) * 8))
     if not _mosaic_ok(block_m, block_n, block_k2, w.group_size):
         return int4_mm_xla(x, w)
+    _kernel_invocations += 1
     Mp = -(-M // block_m) * block_m
     if Mp != M:
         x2d = jnp.pad(x2d, ((0, Mp - M), (0, 0)))

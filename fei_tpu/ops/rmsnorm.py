@@ -7,10 +7,19 @@ import jax
 import jax.numpy as jnp
 
 
-def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float = 1e-5) -> jnp.ndarray:
-    """y = x / rms(x) * weight, computed in fp32 for stability, cast back."""
+def rms_norm(
+    x: jnp.ndarray, weight: jnp.ndarray, eps: float = 1e-5,
+    offset: bool = False,
+) -> jnp.ndarray:
+    """y = x / rms(x) * weight, computed in fp32 for stability, cast back.
+
+    ``offset=True`` multiplies by (1 + weight) instead — the Gemma-family
+    convention, whose checkpoints store norm weights zero-centered."""
     dtype = x.dtype
     xf = x.astype(jnp.float32)
     var = jnp.mean(xf * xf, axis=-1, keepdims=True)
     y = xf * jax.lax.rsqrt(var + eps)
-    return (y * weight.astype(jnp.float32)).astype(dtype)
+    w = weight.astype(jnp.float32)
+    if offset:
+        w = 1.0 + w
+    return (y * w).astype(dtype)

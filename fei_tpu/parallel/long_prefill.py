@@ -27,10 +27,11 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from fei_tpu.models.configs import ModelConfig
-from fei_tpu.models.llama import KVCache, _logits, qkv_proj
+from fei_tpu.models.llama import (
+    KVCache, _logits, _mlp_act, _norm, embed_tokens, qkv_proj,
+)
 from fei_tpu.ops.moe import moe_mlp
 from fei_tpu.ops.quant import mm
-from fei_tpu.ops.rmsnorm import rms_norm
 from fei_tpu.ops.rope import apply_rope, compute_rope_freqs
 from fei_tpu.parallel.ring import _ring_attention_shard, _ulysses_shard
 
@@ -54,7 +55,7 @@ def _prefill_shard(
     positions = jnp.tile(positions, (B, 1))
 
     def body(x, lp):
-        y = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+        y = _norm(x, lp["attn_norm"], cfg)
         q, k, v = qkv_proj(lp, y, Hq, K, d)
         q = apply_rope(q, cos, sin, positions)
         k = apply_rope(k, cos, sin, positions)
@@ -72,14 +73,16 @@ def _prefill_shard(
             o = o + lp["bo"]
         x = x + o
 
-        y = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+        y = _norm(x, lp["mlp_norm"], cfg)
         if cfg.is_moe:
             mlp_out = moe_mlp(
                 y, lp["router"], lp["w_gate"], lp["w_up"], lp["w_down"],
                 cfg.num_experts_per_tok,
             )
         else:
-            act = jax.nn.silu(mm(y, lp["w_gate"]).astype(jnp.float32)).astype(y.dtype)
+            act = _mlp_act(
+                cfg, mm(y, lp["w_gate"]).astype(jnp.float32)
+            ).astype(y.dtype)
             mlp_out = mm(act * mm(y, lp["w_up"]), lp["w_down"])
         return x + mlp_out, (k, v)
 
@@ -117,7 +120,7 @@ def prefill_ring_kv(
 
     dtype = params["embed"].dtype
     cos, sin = compute_rope_freqs(cfg.head_dim_, T, cfg.rope_theta)
-    x = params["embed"][tokens].astype(dtype)  # [B, T, H] (sequence-sharded in)
+    x = embed_tokens(params, cfg, tokens, dtype)  # [B, T, H] (seq-sharded in)
 
     fn = jax.shard_map(
         functools.partial(
@@ -141,7 +144,7 @@ def prefill_ring_kv(
         last = jnp.take_along_axis(
             x, jnp.broadcast_to(idx, (B, 1, x.shape[-1])), axis=1
         )[:, 0, :]
-    last = rms_norm(last, params["final_norm"], cfg.rms_norm_eps)
+    last = _norm(last, params["final_norm"], cfg)
     # kernel_mesh: on an sp+tp mesh a QTensor4 lm_head must route through
     # the shard_map'd kernel (_mm_k checks for a real tp axis; sp-only
     # meshes fall through to the local path)

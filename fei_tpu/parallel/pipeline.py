@@ -24,8 +24,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from fei_tpu.models.configs import ModelConfig
-from fei_tpu.models.llama import _layer, _logits
-from fei_tpu.ops.rmsnorm import rms_norm
+from fei_tpu.models.llama import _layer, _logits, _norm, embed_tokens
 from fei_tpu.ops.rope import compute_rope_freqs
 
 
@@ -113,7 +112,7 @@ def pipeline_forward_train(
     cos, sin = compute_rope_freqs(cfg.head_dim_, T, cfg.rope_theta)
 
     dtype = params["embed"].dtype
-    x = params["embed"][tokens].astype(dtype)  # [B, T, H]
+    x = embed_tokens(params, cfg, tokens, dtype)  # [B, T, H]
     xs = x.reshape(num_micro, mb, T, -1)
 
     layer_specs = jax.tree.map(lambda _: P(axis_name), params["layers"])
@@ -126,5 +125,5 @@ def pipeline_forward_train(
     ys = fn(params["layers"], xs, positions, cos, sin)
     x = ys.reshape(B, T, -1)
 
-    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    x = _norm(x, params["final_norm"], cfg)
     return _logits(x, params, cfg)

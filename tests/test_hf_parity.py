@@ -272,3 +272,104 @@ class TestChatTemplateParity:
         want = fast.apply_chat_template(msgs, add_generation_prompt=True)
         got = ours.apply_chat_template(msgs, add_generation_prompt=True)
         assert list(got) == list(want)
+
+
+def _tiny_hf_gemma(tmp_path):
+    cfg = transformers.GemmaConfig(
+        vocab_size=256,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=3,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        head_dim=32,  # Gemma's head_dim is independent of hidden/heads
+        max_position_embeddings=128,
+        rope_theta=10000.0,
+        rms_norm_eps=1e-5,
+        hidden_activation="gelu_pytorch_tanh",
+    )
+    torch.manual_seed(2)
+    model = transformers.GemmaForCausalLM(cfg).eval()
+    model.save_pretrained(str(tmp_path), safe_serialization=True)
+    return model, cfg
+
+
+class TestGemmaParity:
+    """Gemma family: RMSNorm multiplies by (1 + w) on zero-centered
+    weights, embeddings scale by sqrt(hidden_size), the MLP is GeGLU
+    (tanh-approx gelu), head_dim (2 * hidden/heads here) decouples from
+    hidden/heads, and embeddings are always tied. Gemma's HF config has no
+    flags for any of this — the loader keys off model_type — so this test
+    locks the norm-offset, embed-scale, activation, and config-merge paths
+    at once."""
+
+    def test_logits_match(self, tmp_path):
+        model, _ = _tiny_hf_gemma(tmp_path)
+
+        ids = np.array([[2, 11, 45, 102, 5, 252, 19, 7]], dtype=np.int64)
+        with torch.no_grad():
+            want = model(torch.from_numpy(ids)).logits.float().numpy()
+
+        cfg = get_model_config("tiny")
+        cfg2, params = load_checkpoint(str(tmp_path), cfg, dtype=jnp.float32)
+        assert cfg2.norm_offset and cfg2.embed_scale
+        assert cfg2.hidden_act == "gelu" and cfg2.tie_embeddings
+        assert cfg2.head_dim_ == 32 and cfg2.num_heads == 4
+
+        cache = KVCache.create(cfg2, 1, ids.shape[1], jnp.float32)
+        got, _ = forward(params, cfg2, jnp.asarray(ids, jnp.int32), cache)
+
+        np.testing.assert_allclose(np.asarray(got)[0], want[0], atol=2e-3)
+
+    def test_decode_matches_prefill_split(self, tmp_path):
+        """Prefill(6) + two decode steps == one prefill(8): the cache path
+        (norm offset + scaled embeddings under incremental lengths) agrees
+        with the all-at-once forward."""
+        model, _ = _tiny_hf_gemma(tmp_path)
+        ids = np.array([[2, 11, 45, 102, 5, 252, 19, 7]], dtype=np.int64)
+        cfg = get_model_config("tiny")
+        cfg2, params = load_checkpoint(str(tmp_path), cfg, dtype=jnp.float32)
+
+        cache = KVCache.create(cfg2, 1, 8, jnp.float32)
+        want, _ = forward(params, cfg2, jnp.asarray(ids, jnp.int32), cache)
+
+        cache = KVCache.create(cfg2, 1, 8, jnp.float32)
+        _, cache = forward(params, cfg2, jnp.asarray(ids[:, :6], jnp.int32), cache)
+        got6, cache = forward(params, cfg2, jnp.asarray(ids[:, 6:7], jnp.int32), cache)
+        got7, _ = forward(params, cfg2, jnp.asarray(ids[:, 7:8], jnp.int32), cache)
+
+        np.testing.assert_allclose(
+            np.asarray(got6)[0, 0], np.asarray(want)[0, 6], atol=1e-4
+        )
+        np.testing.assert_allclose(
+            np.asarray(got7)[0, 0], np.asarray(want)[0, 7], atol=1e-4
+        )
+
+    def test_engine_serves_tiny_gemma(self):
+        """The tiny-gemma preset decodes through the engine (random init:
+        zero-centered norms, scaled embeddings, GeGLU) and the paged
+        scheduler serves it identically to the dense path."""
+        from fei_tpu.engine import GenerationConfig, InferenceEngine
+
+        gen = GenerationConfig(max_new_tokens=8, temperature=0.0, ignore_eos=True)
+        eng = InferenceEngine.from_config(
+            "tiny-gemma", tokenizer="byte", max_seq_len=64
+        )
+        # norm_offset random init stores zero-centered norm weights
+        assert float(np.abs(np.asarray(eng.params["final_norm"])).max()) == 0
+        want = eng.generate(eng.tokenizer.encode("gemma probe"), gen).token_ids
+        assert len(want) == 8
+
+        paged = InferenceEngine.from_config(
+            "tiny-gemma", tokenizer="byte", max_seq_len=64, paged=True,
+            batch_size=2, page_size=8,
+        )
+        try:
+            got = list(
+                paged.scheduler.stream(
+                    paged.tokenizer.encode("gemma probe"), gen
+                )
+            )
+            assert got == want
+        finally:
+            paged.close()

@@ -74,18 +74,19 @@ class TestInt4Matmul:
 
     @pytest.mark.parametrize("M,K,N", [(1, 2048, 256), (33, 4096, 512)])
     def test_kernel_matches_fallback(self, M, K, N):
+        import fei_tpu.ops.pallas.int4_matmul as m
+
         w = jax.random.normal(jax.random.PRNGKey(0), (K, N)) * 0.05
         qt = quantize4(w)
         x = jax.random.normal(jax.random.PRNGKey(1), (M, K), jnp.bfloat16)
+        before = m._kernel_invocations
         out_k = int4_mm(x, qt)  # interpret mode on CPU
+        assert m._kernel_invocations == before + 1  # kernel, not fallback
         out_x = int4_mm_xla(x, qt)
         np.testing.assert_allclose(
             np.asarray(out_k, np.float32), np.asarray(out_x, np.float32),
             atol=5e-3,
         )
-        import fei_tpu.ops.pallas.int4_matmul as m
-
-        assert not m._mosaic_failed  # the kernel path actually ran
 
     def test_small_shapes_use_fallback(self):
         """Shapes the kernel can't tile route through XLA, not an error."""
@@ -261,6 +262,15 @@ class TestEngineInt4:
             paged.close()
 
 class TestInt4Mesh:
+    """Mesh composition tests — need multiple devices (the on-chip pipeline
+    runs this file against the single real chip: these must skip, not
+    error, there)."""
+
+    @pytest.fixture(autouse=True)
+    def _needs_devices(self):
+        if len(jax.devices()) < 2:
+            pytest.skip("mesh tests need >=2 devices")
+
     def test_sharded_kernel_no_weight_gather(self):
         """int4_mm_sharded must not all-gather the packed weight (the
         global-view pallas_call does — 13 collectives measured on tp=2);
@@ -351,6 +361,8 @@ class TestInt4Mesh:
             num_heads=8, num_kv_heads=4,
         )
         _write_hf_llama(tmp_path, cfg)
+        if len(jax.devices()) < 8:
+            pytest.skip("sharded streamed load needs the 8-device mesh")
         mesh = make_mesh({"tp": 2, "dp": 4})
         cfg2, q4 = load_checkpoint(
             str(tmp_path), cfg, dtype=jnp.float32,
