@@ -18,6 +18,7 @@ calls leave the process over HTTP, fei/core/assistant.py:524-530):
 from __future__ import annotations
 
 import os
+from types import SimpleNamespace
 from typing import NamedTuple
 
 import jax
@@ -96,18 +97,34 @@ def init_params(
             nonlocal prev
             if prev is not None:
                 k, _ = jax.lax.optimization_barrier((k, prev))
-            w = (
-                jax.random.normal(k, shape, dtype=jnp.float32) * (fan_in ** -0.5)
-            ).astype(dtype)
-            if quant and quantize:
-                if (
-                    quantize == "int4"
-                    and name not in int4_exclude
-                    and _int4_ok(name, w, cfg.is_moe)
-                ):
-                    w = _quantize4_w(w)
-                else:  # int8, and the int4 mode's int8-kept leaves
-                    w = _quantize_w(w)
+            shape_only = SimpleNamespace(shape=shape)  # _int4_ok reads .shape
+            use_int4 = (
+                quant
+                and quantize == "int4"
+                and name not in int4_exclude
+                and _int4_ok(name, shape_only, cfg.is_moe)
+            )
+            if use_int4 and len(shape) >= 3:
+                # int4's reduce(amax)-then-pack chain defeats the fusion
+                # that keeps int8 init memory-flat: XLA materializes the
+                # full stacked fp32 source (w_down at 8B is 7.5 GB) before
+                # the packed bytes exist. Building per layer under lax.map
+                # bounds the fp32 transient to ONE layer's weights.
+                def one_layer(kl):
+                    wl = (
+                        jax.random.normal(kl, shape[1:], dtype=jnp.float32)
+                        * (fan_in ** -0.5)
+                    ).astype(dtype)
+                    return _quantize4_w(wl)
+
+                w = jax.lax.map(one_layer, jax.random.split(k, shape[0]))
+            else:
+                w = (
+                    jax.random.normal(k, shape, dtype=jnp.float32)
+                    * (fan_in ** -0.5)
+                ).astype(dtype)
+                if quant and quantize:
+                    w = _quantize4_w(w) if use_int4 else _quantize_w(w)
             prev = w.q if hasattr(w, "q") else (w.p if hasattr(w, "p") else w)
             return w
 
