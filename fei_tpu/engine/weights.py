@@ -396,6 +396,56 @@ def _build_quantized(plan: _TensorPlan, sharding) -> QTensor:
     return QTensor(q=q, s=s)
 
 
+def _build_embed_quantized(plan: _TensorPlan, shard):
+    """Row-quantized int8 embed table (ops.quant.quantize_embed layout:
+    q [V, h], s [V, 1]). Row scales only need the row itself, and the
+    embed's h axis is never sharded, so each shard's read is self-contained."""
+
+    memo: dict = {}
+    lock = threading.Lock()
+
+    def quant_rows(idx):
+        # q and s callbacks for the same row range (and replicated shards)
+        # share one disk read + quantization, like _build_quantized's memo
+        key = (idx[0].start, idx[0].stop)
+        with lock:
+            hit = memo.get(key)
+        if hit is not None:
+            return hit
+        w = plan.read(idx).astype(np.float32)
+        amax = np.abs(w).max(axis=-1, keepdims=True)
+        s = np.where(amax == 0.0, np.float32(1.0), amax / np.float32(127.0))
+        q = np.clip(np.round(w / s), -127, 127).astype(np.int8)
+        result = (q, s.astype(np.float32))
+        with lock:
+            memo[key] = result
+        return result
+
+    V, h = plan.shape
+    if shard is None:
+        q, s = quant_rows(_full(plan.shape))
+        return QTensor(q=jnp.asarray(q), s=jnp.asarray(s))
+
+    from jax.sharding import NamedSharding
+
+    from fei_tpu.parallel.sharding import _scale_spec
+
+    s_shard = NamedSharding(shard.mesh, _scale_spec(shard.spec, (V, 1)))
+
+    def read_q(idx):
+        idx = _norm_idx(idx, plan.shape)
+        return quant_rows(idx)[0]
+
+    def read_s(idx):
+        idx = _norm_idx(idx, (V, 1))
+        return quant_rows((idx[0], slice(0, h)))[1]
+
+    return QTensor(
+        q=jax.make_array_from_callback(plan.shape, shard, read_q),
+        s=jax.make_array_from_callback((V, 1), s_shard, read_s),
+    )
+
+
 def _spec_entry(spec, axis: int, rank: int):
     """The PartitionSpec entry for ``axis`` of a rank-``rank`` array (specs
     may be shorter than the rank; missing entries are unsharded)."""
@@ -569,6 +619,12 @@ def load_checkpoint(
                 leaf = _build_int8_leaf(plan, shard)
         elif quantize == "int8" and key in QUANT_KEYS:
             leaf = _build_int8_leaf(plan, shard)
+        elif (
+            key == "embed"
+            and quantize
+            and os.environ.get("FEI_TPU_QUANT_EMBED") == "1"
+        ):
+            leaf = _build_embed_quantized(plan, shard)
         else:
             leaf = _build_plain(plan, dtype, shard)
         if path[0] == "layers":

@@ -81,6 +81,7 @@ def init_params(
     cache_key = (
         repr(cfg), str(dtype), quantize, tuple(sorted(int4_exclude)),
         os.environ.get("FEI_TPU_INT4_LM_HEAD"),
+        os.environ.get("FEI_TPU_QUANT_EMBED"),
     )
     built = _INIT_BUILDERS.get(cache_key)
     if built is not None:
@@ -160,8 +161,17 @@ def init_params(
                 w_up=init(next(keys), (L, h, I), h, quant=True, name="w_up"),
                 w_down=init(next(keys), (L, I, h), I, quant=True, name="w_down"),
             )
+        # FEI_TPU_QUANT_EMBED=1 (with any quantize mode): int8 embed table
+        # with per-row scales — halves embed HBM, and for tie_embeddings
+        # models halves the LM-head stream (ops.quant.quantize_embed)
+        quant_embed = bool(quantize) and os.environ.get("FEI_TPU_QUANT_EMBED") == "1"
+        embed = init(next(keys), (cfg.vocab_size, h), h)
+        if quant_embed:
+            from fei_tpu.ops.quant import quantize_embed
+
+            embed = quantize_embed(embed)
         params = {
-            "embed": init(next(keys), (cfg.vocab_size, h), h),
+            "embed": embed,
             "layers": layers,
             "final_norm": ninit((h,), dtype=dtype),
         }
@@ -237,10 +247,19 @@ def _mlp_act(cfg: ModelConfig, gate):
     return jax.nn.silu(gate)
 
 
+def model_dtype(params: dict):
+    """The model compute dtype, read from a leaf that is never quantized
+    (the embed table may be a row-scaled QTensor whose .dtype is fp32)."""
+    return params["layers"]["attn_norm"].dtype
+
+
 def embed_tokens(params: dict, cfg: ModelConfig, tokens, dtype):
-    """Embedding lookup; Gemma scales by sqrt(hidden_size) (in the compute
+    """Embedding lookup (plain or row-quantized table — ops.quant
+    embed_lookup); Gemma scales by sqrt(hidden_size) (in the compute
     dtype, matching HF's normalizer cast)."""
-    x = params["embed"][tokens].astype(dtype)
+    from fei_tpu.ops.quant import embed_lookup
+
+    x = embed_lookup(params["embed"], tokens, dtype)
     if cfg.embed_scale:
         x = x * jnp.asarray(cfg.hidden_size ** 0.5, dtype)
     return x
@@ -346,9 +365,14 @@ def _layer(
 
 
 def _logits(x, params, cfg: ModelConfig, kernel_mesh=None) -> jnp.ndarray:
-    """LM head (quantization-aware); tied embeddings stay bf16."""
+    """LM head (quantization-aware). Tied embeddings project through the
+    (possibly row-quantized) embed table — ops.quant.tied_logits applies
+    the row scales to the result columns, exact since each scale is
+    constant along the contraction."""
     if cfg.tie_embeddings:
-        return (x @ params["embed"].T.astype(x.dtype)).astype(jnp.float32)
+        from fei_tpu.ops.quant import tied_logits
+
+        return tied_logits(x, params["embed"])
     return _mm_k(x, params["lm_head"], kernel_mesh).astype(jnp.float32)
 
 
@@ -474,7 +498,7 @@ def forward_paged_block(
     sharded = kernel_mesh is not None and kernel_mesh.shape.get("tp", 1) > 1
 
     kv_int8 = cache.k_scales is not None
-    dtype = params["embed"].dtype if kv_int8 else cache.k_pages.dtype
+    dtype = model_dtype(params) if kv_int8 else cache.k_pages.dtype
     x = embed_tokens(params, cfg, tokens, dtype)  # [B, T, h]
 
     def body(x, layer_inputs):
@@ -576,7 +600,7 @@ def forward_train(
     cos, sin = compute_rope_freqs(cfg.head_dim_, T, cfg.rope_theta)
     kv_length = jnp.zeros((B,), dtype=jnp.int32)
 
-    dtype = params["embed"].dtype
+    dtype = model_dtype(params)
     x = embed_tokens(params, cfg, tokens, dtype)
 
     def body(x, lp):

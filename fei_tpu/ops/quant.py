@@ -134,6 +134,36 @@ def unpack4(p: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
     return (p32 << 28) >> 28, p32 >> 4
 
 
+def quantize_embed(w: jnp.ndarray) -> QTensor:
+    """Symmetric int8 embedding table with per-ROW scales (q [V, h],
+    s [V, 1]). The lookup is a gather (row + its scale); a tied LM head
+    consumes it exactly via result-side column scaling:
+    ``x @ (q*s).T == (x @ q.T) * s.T`` since each scale is constant along
+    the contraction. Halves embed HBM — and for tie_embeddings models,
+    halves the LM-head weight stream (the decode bottleneck's last bf16
+    holdout)."""
+    amax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=-1, keepdims=True)
+    s = jnp.where(amax == 0.0, 1.0, amax / 127.0)
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) / s), -127, 127).astype(jnp.int8)
+    return QTensor(q=q, s=s)
+
+
+def embed_lookup(embed, ids, dtype):
+    """Row gather for a plain or row-quantized (quantize_embed) table."""
+    if isinstance(embed, QTensor):
+        rows = embed.q[ids].astype(jnp.float32) * embed.s[ids]
+        return rows.astype(dtype)
+    return embed[ids].astype(dtype)
+
+
+def tied_logits(x, embed):
+    """``x @ embed.T`` for a plain or row-quantized table (fp32 out)."""
+    if isinstance(embed, QTensor):
+        out = x @ embed.q.T.astype(x.dtype)
+        return out.astype(jnp.float32) * embed.s[:, 0].astype(jnp.float32)
+    return (x @ embed.T.astype(x.dtype)).astype(jnp.float32)
+
+
 def dequantize(w, dtype=jnp.bfloat16):
     """QTensor/QTensor4 -> dense array; identity on plain arrays."""
     if isinstance(w, QTensor):

@@ -28,7 +28,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from fei_tpu.models.configs import ModelConfig
 from fei_tpu.models.llama import (
-    KVCache, _logits, _mlp_act, _norm, embed_tokens, qkv_proj,
+    KVCache, _logits, _mlp_act, _norm, embed_tokens, model_dtype, qkv_proj,
 )
 from fei_tpu.ops.moe import moe_mlp
 from fei_tpu.ops.quant import mm
@@ -118,7 +118,7 @@ def prefill_ring_kv(
             f"(H={cfg.num_heads}, K={cfg.num_kv_heads})"
         )
 
-    dtype = params["embed"].dtype
+    dtype = model_dtype(params)
     cos, sin = compute_rope_freqs(cfg.head_dim_, T, cfg.rope_theta)
     x = embed_tokens(params, cfg, tokens, dtype)  # [B, T, H] (seq-sharded in)
 
@@ -170,7 +170,7 @@ def prefill_ring(
     logits, k_all, v_all = prefill_ring_kv(
         params, cfg, tokens, mesh, axis_name=axis_name, attend=attend
     )
-    dtype = params["embed"].dtype
+    dtype = model_dtype(params)
 
     S = max_seq_len or T
     if S < T:

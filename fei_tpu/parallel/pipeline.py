@@ -24,7 +24,9 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from fei_tpu.models.configs import ModelConfig
-from fei_tpu.models.llama import _layer, _logits, _norm, embed_tokens
+from fei_tpu.models.llama import (
+    _layer, _logits, _norm, embed_tokens, model_dtype,
+)
 from fei_tpu.ops.rope import compute_rope_freqs
 
 
@@ -111,7 +113,7 @@ def pipeline_forward_train(
     positions = jnp.tile(jnp.arange(T, dtype=jnp.int32)[None, :], (mb, 1))
     cos, sin = compute_rope_freqs(cfg.head_dim_, T, cfg.rope_theta)
 
-    dtype = params["embed"].dtype
+    dtype = model_dtype(params)
     x = embed_tokens(params, cfg, tokens, dtype)  # [B, T, H]
     xs = x.reshape(num_micro, mb, T, -1)
 

@@ -1,0 +1,111 @@
+"""Row-quantized int8 embedding table (ops.quant.quantize_embed).
+
+The lookup is a gather (row + its per-row scale); tied LM heads consume it
+via exact result-side column scaling. Halves embed HBM and, for
+tie_embeddings models, halves the LM-head weight stream.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fei_tpu.ops.quant import (
+    QTensor,
+    embed_lookup,
+    quantize_embed,
+    tied_logits,
+)
+
+
+class TestQuantizeEmbed:
+    def test_roundtrip_per_row_bound(self):
+        w = jax.random.normal(jax.random.PRNGKey(0), (128, 64))
+        qt = quantize_embed(w)
+        assert qt.q.shape == (128, 64) and qt.s.shape == (128, 1)
+        back = np.asarray(qt.q, np.float32) * np.asarray(qt.s)
+        step = np.abs(np.asarray(w)).max(axis=-1, keepdims=True) / 127.0
+        assert (np.abs(back - np.asarray(w)) <= step / 2 + 1e-7).all()
+
+    def test_lookup_matches_dequant(self):
+        w = jax.random.normal(jax.random.PRNGKey(0), (128, 64))
+        qt = quantize_embed(w)
+        ids = jnp.array([[3, 77, 0, 127]], jnp.int32)
+        got = embed_lookup(qt, ids, jnp.float32)
+        want = (np.asarray(qt.q, np.float32) * np.asarray(qt.s))[
+            np.asarray(ids)
+        ]
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-6)
+        # plain tables pass through
+        np.testing.assert_allclose(
+            np.asarray(embed_lookup(w, ids, jnp.float32)),
+            np.asarray(w)[np.asarray(ids)],
+        )
+
+    def test_tied_logits_result_side_scaling_exact(self):
+        w = jax.random.normal(jax.random.PRNGKey(0), (128, 64))
+        qt = quantize_embed(w)
+        x = jax.random.normal(jax.random.PRNGKey(1), (2, 64), jnp.float32)
+        got = tied_logits(x, qt)
+        dequant = (
+            np.asarray(qt.q, np.float32) * np.asarray(qt.s)
+        )
+        want = np.asarray(x, np.float32) @ dequant.T
+        # result-side scaling is exact in real arithmetic; fp32 rounding
+        # differs ~1 ulp from the dequantize-first order
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4, atol=1e-5)
+
+
+class TestEngineEmbedQuant:
+    def test_tied_engine_decodes_and_shrinks(self, monkeypatch):
+        """A tied-embeddings engine with FEI_TPU_QUANT_EMBED=1 decodes
+        token-identically to the same params with the embed dequantized,
+        and the table is actually int8."""
+        from fei_tpu.engine import GenerationConfig, InferenceEngine
+        from fei_tpu.ops.quant import dequantize
+
+        monkeypatch.setenv("FEI_TPU_QUANT_EMBED", "1")
+        kw = dict(
+            dtype=jnp.bfloat16, seed=0, tokenizer="byte", max_seq_len=64,
+            num_layers=2, tie_embeddings=True,
+        )
+        gen = GenerationConfig(max_new_tokens=10, temperature=0.0, ignore_eos=True)
+        eng = InferenceEngine.from_config("tiny", quantize="int8", **kw)
+        assert isinstance(eng.params["embed"], QTensor)
+        assert eng.params["embed"].q.dtype == jnp.int8
+        ids_q = eng.generate(eng.tokenizer.encode("embed probe"), gen).token_ids
+
+        monkeypatch.delenv("FEI_TPU_QUANT_EMBED")
+        eng2 = InferenceEngine.from_config("tiny", quantize="int8", **kw)
+        eng2.params = dict(eng2.params)
+        eng2.params["embed"] = dequantize(eng.params["embed"], jnp.bfloat16)
+        eng2.params["layers"] = eng.params["layers"]
+        eng2.params["final_norm"] = eng.params["final_norm"]
+        ids = eng2.generate(eng2.tokenizer.encode("embed probe"), gen).token_ids
+        assert ids_q == ids
+
+    def test_streamed_load_quantized_embed(self, tmp_path, monkeypatch):
+        from test_streamed_load import _write_hf_llama
+
+        from fei_tpu.engine.weights import load_checkpoint
+        from fei_tpu.models.configs import get_model_config
+        from fei_tpu.models.llama import KVCache, forward
+
+        cfg = get_model_config("tiny")
+        _write_hf_llama(tmp_path, cfg)
+        monkeypatch.setenv("FEI_TPU_QUANT_EMBED", "1")
+        cfg2, params = load_checkpoint(
+            str(tmp_path), cfg, dtype=jnp.float32, quantize="int8"
+        )
+        assert isinstance(params["embed"], QTensor)
+        monkeypatch.delenv("FEI_TPU_QUANT_EMBED")
+        _, eager = load_checkpoint(str(tmp_path), cfg, dtype=jnp.float32)
+        from fei_tpu.ops.quant import quantize_embed as qe
+
+        ref = qe(eager["embed"])
+        np.testing.assert_array_equal(
+            np.asarray(params["embed"].q), np.asarray(ref.q)
+        )
+        tokens = jnp.array([[5, 6, 7]], jnp.int32)
+        cache = KVCache.create(cfg2, 1, 8, jnp.float32)
+        logits, _ = forward(params, cfg2, tokens, cache)
+        assert np.isfinite(np.asarray(logits)).all()
