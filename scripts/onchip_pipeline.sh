@@ -16,6 +16,9 @@ OUT="${OUT:-/tmp/onchip}"
 REPORT="${REPORT:-/root/repo/ONCHIP_RESULTS.md}"
 mkdir -p "$OUT"
 cd /root/repo
+# a rerun rewrites the report from this run's logs only — keep the prior
+# run's numbers (e.g. the committed gate results) readable beside it
+[ -f "$REPORT" ] && cp -f "$REPORT" "${REPORT%.md}_prev.md"
 : > "$OUT/pipeline.log"  # per-run logs: re-runs must not inherit old state
 : > "$OUT/stages.lst"
 echo "=== pipeline start $(date -u) ===" >> "$OUT/pipeline.log"
@@ -82,6 +85,10 @@ stage bench_moe env FEI_TPU_BENCH_SUITE=moe FEI_TPU_BENCH_MAX_WAIT_S=300 \
 
 # 6. int8-KV paged decode variant
 stage bench_paged_kv8 env FEI_TPU_BENCH_SUITE=paged FEI_TPU_BENCH_KV_QUANT=int8 \
+  FEI_TPU_BENCH_MAX_WAIT_S=300 python -u bench.py
+
+# 6b. paged aggregate at higher concurrency (where utilization lives)
+stage bench_paged_8s env FEI_TPU_BENCH_SUITE=paged FEI_TPU_BENCH_STREAMS=8 \
   FEI_TPU_BENCH_MAX_WAIT_S=300 python -u bench.py
 
 # 7. agent suite: end-to-end `fei --message` through the whole stack
