@@ -15,6 +15,9 @@ Suites (FEI_TPU_BENCH_SUITE):
                      task-loop serving shape)
   moe              — routed-MoE decode on the bench-scale Mixtral-shaped
                      config (BASELINE config #4 on one chip)
+  prefill          — TTFT for an FEI_TPU_BENCH_PREFILL_LEN-token prompt
+                     (default 4096) through the paged scheduler's chunked
+                     admission (the serving path); emits prefill tok/s
   agent            — end-to-end `fei --message` through the whole stack
   remote           — BASELINE config #1: client-path floor via
                      RemoteProvider against a loopback OpenAI-compatible
@@ -274,6 +277,54 @@ def bench_decode(model: str, n_tokens: int) -> int:
     quant = os.environ.get("FEI_TPU_BENCH_QUANT")
     tag = f"{model}-{quant}" if quant else model
     return _emit(f"{tag}_decode_tok_s_per_chip", tok_s)
+
+
+def bench_prefill(model: str, n_tokens: int) -> int:
+    """Prefill latency at agent-loop prompt lengths: time-to-first-token
+    for an N-token prompt through the SERVING path — the paged scheduler's
+    chunked admission (prompts enter the pool chunk by chunk, interleaved
+    with live decode; scheduler.py) — not the dense monolithic prefill.
+    Decode throughput never sees this cost; TTFT is its own budget (the
+    BASELINE north-star pins p50 TTFT < 500 ms).
+
+    FEI_TPU_BENCH_PREFILL_LEN (default 4096; capped at 512 on the CPU
+    fallback) sets the prompt length; ``n_tokens`` is unused — this suite
+    times the prompt side, not decode. Emits prefill tokens/sec
+    (prompt_len / ttft)."""
+    from fei_tpu.engine import GenerationConfig
+
+    plen = int(os.environ.get("FEI_TPU_BENCH_PREFILL_LEN", "4096"))
+    if os.environ.get("FEI_TPU_BENCH_CPU_FALLBACK"):
+        plen = min(plen, 512)
+    engine = _make_engine(
+        model, max_seq_len=plen + 64, paged=True, batch_size=1,
+    )
+    # a prompt of byte-tokenizer ids; content is irrelevant to timing
+    prompt = (list(range(1, 256)) * (plen // 255 + 1))[:plen]
+    gen = GenerationConfig(max_new_tokens=1, temperature=0.0, ignore_eos=True)
+
+    def one_ttft() -> float:
+        t0 = time.time()
+        stream = engine.scheduler.stream(prompt, gen)
+        next(iter(stream))
+        return time.time() - t0
+
+    t0 = time.time()
+    one_ttft()
+    log(f"bench: prefill warm-up (compile) {time.time()-t0:.1f}s")
+
+    ttfts = []
+    for i in range(3):
+        t = one_ttft()
+        ttfts.append(t)
+        log(f"bench: prefill run {i}: {plen} tokens, ttft={t*1000:.1f}ms "
+            f"-> {plen/t:.0f} tok/s chunked admission")
+    p50 = sorted(ttfts)[len(ttfts) // 2]
+    log(f"bench: p50 prefill ttft={p50*1000:.1f}ms for {plen} tokens")
+    engine.close()
+    quant = os.environ.get("FEI_TPU_BENCH_QUANT")
+    tag = f"{model}-{quant}" if quant else model
+    return _emit(f"{tag}_prefill{plen}_tok_s_per_chip", plen / p50)
 
 
 def bench_paged(model: str, n_tokens: int) -> int:
@@ -596,7 +647,7 @@ def main() -> int:
         default_model = "llama3-1b"
     model = os.environ.get("FEI_TPU_BENCH_MODEL", default_model)
     if (
-        suite == "decode"
+        suite in ("decode", "prefill")
         and model == "llama3-8b"
         and "FEI_TPU_BENCH_QUANT" not in os.environ
     ):
@@ -618,6 +669,8 @@ def main() -> int:
         n_tokens = min(n_tokens, 32)
     log(f"bench: suite={suite} model={model} backend={backend} devices={devices}")
 
+    if suite == "prefill":
+        return bench_prefill(model, n_tokens)
     if suite == "paged":
         return bench_paged(model, n_tokens)
     if suite == "moe":

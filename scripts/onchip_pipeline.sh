@@ -101,6 +101,11 @@ stage int4_tests env FEI_TPU_TEST_PLATFORM=tpu python -m pytest \
 stage bench_8b_int4 env FEI_TPU_BENCH_QUANT=int4 FEI_TPU_BENCH_MAX_WAIT_S=300 \
   python -u bench.py
 
+# 9. prefill latency at agent-loop prompt length (8B int8, 4096 tokens)
+stage bench_prefill env FEI_TPU_BENCH_SUITE=prefill \
+  FEI_TPU_BENCH_MODEL=llama3-8b FEI_TPU_BENCH_QUANT=int8 \
+  FEI_TPU_BENCH_MAX_WAIT_S=300 python -u bench.py
+
 echo "=== pipeline done $(date -u) ===" >> "$OUT/pipeline.log"
 report
 touch "$OUT/DONE"
