@@ -122,6 +122,12 @@ class InferenceEngine:
                 "kv_quant requires paged=True (the contiguous KVCache path "
                 "has no quantized variant)"
             )
+        if model_cfg.sliding_window and paged:
+            raise EngineError(
+                "sliding-window models serve through the dense-cache engine "
+                "this round (the paged kernels have no window mask yet) — "
+                "construct without paged=True"
+            )
         self.kv_quant = kv_quant
         # opt-in (vLLM-style): shared page-aligned prompt prefixes are
         # cached and reused across requests by the scheduler
@@ -603,6 +609,9 @@ class InferenceEngine:
             or "sp" not in self.mesh.axis_names
             or self.mesh.shape["sp"] <= 1
             or n_tokens < self.long_prefill_min
+            # ring/ulysses shards have no sliding-window mask yet; SWA
+            # prompts stay on the (window-correct) dense path
+            or self.cfg.sliding_window
         ):
             return False
         bucket = min(_next_bucket(n_tokens), self.max_seq_len)

@@ -695,6 +695,30 @@ def _merge_hf_config(ckpt_dir: str, cfg: ModelConfig) -> ModelConfig:
             ),
         )
     fields = {k: v for k, v in fields.items() if v is not None}
+    # sliding_window is set AFTER the None-filter: a null value must be able
+    # to DISABLE a preset's window (Mistral v0.2+ sets sliding_window: null
+    # while the mistral-7b preset defaults to v0.1's 4096)
+    if hf.get("model_type") == "mistral":
+        fields["sliding_window"] = hf.get("sliding_window")
+    elif hf.get("use_sliding_window"):
+        # HF Qwen2 semantics: only layers with index >= max_window_layers
+        # window (default max_window_layers == num_layers: SWA applies to
+        # zero layers). The scan-stacked decoder has one uniform window, so
+        # partial per-layer windowing is rejected loudly rather than
+        # silently mis-windowing every layer.
+        mwl = hf.get("max_window_layers", 0) or 0
+        n_layers = hf.get("num_hidden_layers", 0) or 0
+        if mwl >= n_layers:
+            fields["sliding_window"] = None
+        elif mwl == 0:
+            fields["sliding_window"] = hf.get("sliding_window")
+        else:
+            raise CheckpointError(
+                f"per-layer sliding window (max_window_layers={mwl} < "
+                f"num_hidden_layers={n_layers}) is not representable in the "
+                "uniform-window decoder; refusing to load rather than "
+                "mis-window layers"
+            )
     return replace(cfg, **fields)
 
 

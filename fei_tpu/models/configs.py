@@ -38,6 +38,9 @@ class ModelConfig:
     norm_offset: bool = False
     embed_scale: bool = False
     hidden_act: str = "silu"  # "silu" (SwiGLU) | "gelu" (GeGLU, tanh approx)
+    # Mistral-v0.1-style sliding-window attention: each query attends to at
+    # most the last `sliding_window` positions (None = full causal)
+    sliding_window: int | None = None
     # tokenizer/bos/eos defaults (overridden by a real tokenizer when loaded)
     bos_token_id: int = 1
     eos_token_id: int = 2
@@ -155,6 +158,17 @@ MODEL_CONFIGS: dict[str, ModelConfig] = {
         name="tiny-bias", vocab_size=512, hidden_size=64,
         intermediate_size=128, num_layers=2, num_heads=4, num_kv_heads=2,
         max_seq_len=2048, attn_bias=True,
+    ),
+    # Mistral family (Llama block + sliding-window attention)
+    "tiny-swa": ModelConfig(
+        name="tiny-swa", vocab_size=512, hidden_size=64,
+        intermediate_size=128, num_layers=2, num_heads=4, num_kv_heads=2,
+        max_seq_len=2048, sliding_window=8, rope_theta=10000.0,
+    ),
+    "mistral-7b": ModelConfig(
+        name="mistral-7b", vocab_size=32000, hidden_size=4096,
+        intermediate_size=14336, num_layers=32, num_heads=32, num_kv_heads=8,
+        rope_theta=10000.0, max_seq_len=32768, sliding_window=4096,
     ),
     # Gemma family (norm offset, GeGLU, scaled embeddings, head_dim 256,
     # always-tied embeddings, rope 10000)

@@ -299,7 +299,7 @@ def qkv_proj(lp, y, Hq: int, K: int, d: int, kernel_mesh=None):
     )
 
 
-def _attend(q, k, v, kv_length, positions):
+def _attend(q, k, v, kv_length, positions, window: int = 0):
     """Pick the attention path at trace time.
 
     FEI_TPU_FLASH=1 forces the Pallas flash kernel (interpret mode off-TPU,
@@ -307,6 +307,8 @@ def _attend(q, k, v, kv_length, positions):
     TPU prefill-sized T. ``kv_length`` is the pre-write cache length [B];
     keys are valid below kv_length + T. The kernel has a Pallas flash
     backward (custom_vjp, recompute) so the training path uses it too.
+    ``window``: sliding-window attention (cfg.sliding_window) — both paths
+    mask keys at positions <= p - window.
     """
     T = q.shape[1]
     mode = os.environ.get("FEI_TPU_FLASH", "auto")
@@ -317,8 +319,10 @@ def _attend(q, k, v, kv_length, positions):
     if use_flash:
         from fei_tpu.ops.pallas import flash_attention
 
-        return flash_attention(q, k, v, kv_length, kv_length + T)
-    return attention(q, k, v, positions, kv_length + T)
+        return flash_attention(
+            q, k, v, kv_length, kv_length + T, window=window
+        )
+    return attention(q, k, v, positions, kv_length + T, window=window)
 
 
 def _layer(
@@ -347,7 +351,10 @@ def _layer(
         new_k = jax.vmap(write)(cache_k, k, kv_length)
         new_v = jax.vmap(write)(cache_v, v, kv_length)
 
-    attn_out = _attend(q, new_k, new_v, kv_length, positions)
+    attn_out = _attend(
+        q, new_k, new_v, kv_length, positions,
+        window=cfg.sliding_window or 0,
+    )
     o = mm(attn_out.reshape(B, T, Hq * d), lp["wo"])
     if "bo" in lp:  # HF Llama attention_bias=true also biases o_proj
         o = o + lp["bo"]

@@ -22,6 +22,7 @@ def attention(
     q_positions: jnp.ndarray,  # [B, T] absolute position of each query token
     kv_length: jnp.ndarray | int,  # [B] or scalar: valid prefix length of cache
     scale: float | None = None,
+    window: int = 0,  # sliding window: 0 = full causal; w = last w positions
 ) -> jnp.ndarray:
     B, T, H, D = q.shape
     S, K = k.shape[1], k.shape[2]
@@ -35,9 +36,11 @@ def attention(
     scores = scores * scale
 
     # mask: key position s is visible to query at absolute position p iff
-    # s <= p and s < kv_length
+    # s <= p and s < kv_length (and, sliding-window, s > p - window)
     s_pos = jnp.arange(S)[None, None, :]  # [1, 1, S]
     causal = s_pos <= q_positions[:, :, None]  # [B, T, S]
+    if window:
+        causal &= s_pos > q_positions[:, :, None] - window
     if isinstance(kv_length, int):
         valid = s_pos < kv_length
     else:

@@ -61,6 +61,7 @@ def _fwd_kernel(
     block_k: int,
     scale: float,
     save_lse: bool,
+    window: int,
 ):
     if save_lse:
         lse_ref, m_ref, l_ref, acc_ref = rest
@@ -93,6 +94,11 @@ def _fwd_kernel(
         ki * block_k <= q_start + qi * block_q + block_q - 1,
         ki * block_k < kv_len,
     )
+    if window:  # k tiles entirely below every query's window are dead
+        block_live = jnp.logical_and(
+            block_live,
+            (ki + 1) * block_k - 1 > q_start + qi * block_q - window,
+        )
 
     @pl.when(block_live)
     def _compute():
@@ -107,6 +113,8 @@ def _fwd_kernel(
         ) * scale  # [block_q, block_k]
 
         mask = jnp.logical_and(k_pos <= q_pos, k_pos < kv_len)
+        if window:  # sliding window: only the last `window` positions
+            mask = jnp.logical_and(mask, k_pos > q_pos - window)
         s = jnp.where(mask, s, NEG_INF)
 
         m_prev = m_ref[:]  # [block_q, 1]
@@ -151,7 +159,7 @@ def _resolve_blocks(T: int, S: int, block_q: int, block_k: int):
 
 def _fwd_impl(
     q, k, v, q_start, kv_length, scale, block_q, block_k, interpret,
-    save_lse,
+    save_lse, window,
 ):
     """Returns (out [B,T,H,D], lse or None). ``save_lse=False`` (the
     inference primal) emits no logsumexp output at all — zero extra HBM."""
@@ -177,6 +185,7 @@ def _fwd_impl(
 
     kernel = functools.partial(
         _fwd_kernel, block_q=block_q, block_k=block_k, scale=scale,
+        window=window,
         save_lse=save_lse,
     )
 
@@ -239,7 +248,7 @@ def _dq_kernel(
     lse_ref, dsum_ref,  # [1,1,bq,_LANES] (lane 0 carries the value)
     dq_ref,  # [1,1,bq,D] out
     dq_acc,  # [bq, D] scratch
-    *, block_q, block_k, scale,
+    *, block_q, block_k, scale, window,
 ):
     b = pl.program_id(0)
     qi = pl.program_id(2)
@@ -262,6 +271,11 @@ def _dq_kernel(
         ki * block_k <= q_start + qi * block_q + block_q - 1,
         ki * block_k < kv_len,
     )
+    if window:  # k tiles entirely below every query's window are dead
+        block_live = jnp.logical_and(
+            block_live,
+            (ki + 1) * block_k - 1 > q_start + qi * block_q - window,
+        )
 
     @pl.when(block_live)
     def _compute():
@@ -277,6 +291,8 @@ def _dq_kernel(
             preferred_element_type=jnp.float32,
         ) * scale
         mask = jnp.logical_and(k_pos <= q_pos, k_pos < kv_len)
+        if window:  # sliding window: only the last `window` positions
+            mask = jnp.logical_and(mask, k_pos > q_pos - window)
         s = jnp.where(mask, s, NEG_INF)
         p = jnp.exp(s - lse)  # [bq, bk] (0 where masked or empty row)
 
@@ -302,7 +318,7 @@ def _dkv_kernel(
     lse_ref, dsum_ref,  # [1,1,bq,_LANES]
     dk_ref, dv_ref,  # [1,1,bk,D] out (per query head)
     dk_acc, dv_acc,  # [bk, D] scratch
-    *, block_q, block_k, scale,
+    *, block_q, block_k, scale, window,
 ):
     b = pl.program_id(0)
     ki = pl.program_id(2)
@@ -326,6 +342,11 @@ def _dkv_kernel(
         ki * block_k <= q_start + qi * block_q + block_q - 1,
         ki * block_k < kv_len,
     )
+    if window:  # k tiles entirely below every query's window are dead
+        block_live = jnp.logical_and(
+            block_live,
+            (ki + 1) * block_k - 1 > q_start + qi * block_q - window,
+        )
 
     @pl.when(block_live)
     def _compute():
@@ -341,6 +362,8 @@ def _dkv_kernel(
             preferred_element_type=jnp.float32,
         ) * scale
         mask = jnp.logical_and(k_pos <= q_pos, k_pos < kv_len)
+        if window:  # sliding window: only the last `window` positions
+            mask = jnp.logical_and(mask, k_pos > q_pos - window)
         s = jnp.where(mask, s, NEG_INF)
         p = jnp.exp(s - lse)  # [bq, bk]
 
@@ -369,7 +392,7 @@ def _dkv_kernel(
 
 
 def _bwd_impl(
-    scale, block_q, block_k, interpret, res, dout
+    scale, block_q, block_k, interpret, window, res, dout
 ):
     q, k, v, q_start, kv_length, out, lse = res
     B, T, H, D = q.shape
@@ -419,7 +442,8 @@ def _bwd_impl(
 
     dq = pl.pallas_call(
         functools.partial(
-            _dq_kernel, block_q=block_q, block_k=block_k, scale=scale
+            _dq_kernel, block_q=block_q, block_k=block_k, scale=scale,
+            window=window,
         ),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
@@ -453,7 +477,8 @@ def _bwd_impl(
 
     dk_h, dv_h = pl.pallas_call(
         functools.partial(
-            _dkv_kernel, block_q=block_q, block_k=block_k, scale=scale
+            _dkv_kernel, block_q=block_q, block_k=block_k, scale=scale,
+            window=window,
         ),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
@@ -489,19 +514,23 @@ def _bwd_impl(
     return dq, dk, dv, zero(q_start), zero(kv_length)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3))
-def _flash(scale, block_q, block_k, interpret, q, k, v, q_start, kv_length):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3, 4))
+def _flash(
+    scale, block_q, block_k, interpret, window, q, k, v, q_start, kv_length
+):
     out, _ = _fwd_impl(
         q, k, v, q_start, kv_length, scale, block_q, block_k, interpret,
-        save_lse=False,
+        save_lse=False, window=window,
     )
     return out
 
 
-def _flash_fwd(scale, block_q, block_k, interpret, q, k, v, q_start, kv_length):
+def _flash_fwd(
+    scale, block_q, block_k, interpret, window, q, k, v, q_start, kv_length
+):
     out, lse = _fwd_impl(
         q, k, v, q_start, kv_length, scale, block_q, block_k, interpret,
-        save_lse=True,
+        save_lse=True, window=window,
     )
     return out, (q, k, v, q_start, kv_length, out, lse)
 
@@ -511,7 +540,7 @@ _flash.defvjp(_flash_fwd, _bwd_impl)
 
 @functools.partial(
     jax.jit,
-    static_argnames=("scale", "block_q", "block_k", "interpret"),
+    static_argnames=("scale", "block_q", "block_k", "interpret", "window"),
 )
 def flash_attention(
     q: jnp.ndarray,  # [B, T, H, D]
@@ -523,11 +552,15 @@ def flash_attention(
     block_q: int = 128,
     block_k: int = 128,
     interpret: bool | None = None,
+    window: int = 0,
 ) -> jnp.ndarray:
     """Causal flash attention against a (possibly longer) KV buffer.
 
     Same contract as fei_tpu.ops.attention.attention: key position s is
-    visible to the query at absolute position p iff s <= p and s < kv_length.
+    visible to the query at absolute position p iff s <= p and s < kv_length
+    — and, with ``window`` (sliding-window attention), additionally
+    s > p - window; the window mask and tile liveness run in the forward
+    AND both backward kernels, so SWA training grads match the oracle.
     Returns [B, T, H, D] in q.dtype. Differentiable w.r.t. q/k/v via the
     Pallas flash backward (recompute; O(T·D) memory both ways).
     """
@@ -536,4 +569,6 @@ def flash_attention(
         scale = D ** -0.5
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    return _flash(scale, block_q, block_k, interpret, q, k, v, q_start, kv_length)
+    return _flash(
+        scale, block_q, block_k, interpret, window, q, k, v, q_start, kv_length
+    )

@@ -1,0 +1,229 @@
+"""Sliding-window attention (Mistral-v0.1 style, cfg.sliding_window).
+
+Guarantees, layered like the flash suite:
+- the XLA oracle masks exactly positions <= p - window
+- the flash kernel (interpret on CPU) matches the oracle, forward and
+  backward (the window mask runs in the dq/dkv kernels too)
+- an engine decode over a window-sized cache matches a from-scratch
+  forward (the cache path honors the window across incremental lengths)
+- HF golden parity vs transformers MistralForCausalLM with a window small
+  enough to bite at test length
+- paged serving is rejected loudly (the paged kernels have no window mask)
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fei_tpu.ops.attention import attention
+
+
+def _rand_qkv(key, B, T, S, H, K, D):
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (B, T, H, D), jnp.float32)
+    k = jax.random.normal(kk, (B, S, K, D), jnp.float32)
+    v = jax.random.normal(kv, (B, S, K, D), jnp.float32)
+    return q, k, v
+
+
+class TestOracleWindow:
+    def test_window_masks_exactly(self):
+        """Brute-force check: output at position p must equal attention
+        computed over only keys (p-w, p]."""
+        B, T, H, K, D, W = 1, 12, 2, 1, 8, 4
+        q, k, v = _rand_qkv(jax.random.PRNGKey(0), B, T, T, H, K, D)
+        positions = jnp.arange(T)[None, :]
+        out = attention(q, k, v, positions, T, window=W)
+        for p in range(T):
+            lo = max(0, p - W + 1)
+            ref = attention(
+                q[:, p : p + 1],
+                k[:, lo : p + 1],
+                v[:, lo : p + 1],
+                jnp.array([[p - lo]]),  # position within the slice
+                p + 1 - lo,
+            )
+            np.testing.assert_allclose(
+                np.asarray(out[:, p]), np.asarray(ref[:, 0]), atol=1e-5
+            )
+
+    def test_window_off_is_full_causal(self):
+        B, T, H, K, D = 1, 8, 2, 2, 8
+        q, k, v = _rand_qkv(jax.random.PRNGKey(1), B, T, T, H, K, D)
+        positions = jnp.arange(T)[None, :]
+        a = attention(q, k, v, positions, T, window=0)
+        b = attention(q, k, v, positions, T)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+class TestFlashWindow:
+    @pytest.mark.parametrize("T,S,q_start,W", [
+        (16, 64, 0, 8), (64, 64, 0, 16), (8, 128, 40, 24),
+    ])
+    def test_matches_oracle(self, T, S, q_start, W):
+        from fei_tpu.ops.pallas import flash_attention
+
+        B, H, K, D = 1, 4, 2, 64
+        q, k, v = _rand_qkv(jax.random.PRNGKey(0), B, T, S, H, K, D)
+        kv_len = jnp.array([q_start + T], jnp.int32)
+        starts = jnp.array([q_start], jnp.int32)
+        positions = q_start + jnp.arange(T)[None, :]
+        got = flash_attention(
+            q, k, v, starts, kv_len, block_q=16, block_k=16, window=W
+        )
+        want = attention(q, k, v, positions, kv_len, window=W)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), atol=2e-3
+        )
+
+    def test_backward_matches_oracle(self):
+        """Window mask must run in the dq/dkv kernels too: grads of an
+        arbitrary scalar loss agree with the oracle's autodiff."""
+        from fei_tpu.ops.pallas import flash_attention
+
+        B, T, H, K, D, W = 1, 32, 2, 1, 64, 8
+        q, k, v = _rand_qkv(jax.random.PRNGKey(2), B, T, T, H, K, D)
+        starts = jnp.zeros((B,), jnp.int32)
+        kv_len = jnp.full((B,), T, jnp.int32)
+        positions = jnp.arange(T)[None, :]
+        probe = jax.random.normal(jax.random.PRNGKey(3), (B, T, H, D))
+
+        def loss_flash(q, k, v):
+            out = flash_attention(
+                q, k, v, starts, kv_len, block_q=16, block_k=16, window=W
+            )
+            return jnp.sum(out * probe)
+
+        def loss_oracle(q, k, v):
+            return jnp.sum(attention(q, k, v, positions, T, window=W) * probe)
+
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        go = jax.grad(loss_oracle, argnums=(0, 1, 2))(q, k, v)
+        for a, b, name in zip(gf, go, "qkv"):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=5e-3,
+                err_msg=f"d{name} mismatch",
+            )
+
+
+class TestEngineSWA:
+    def test_decode_honors_window_across_cache_growth(self):
+        """Greedy decode with a window smaller than the context must match
+        token-by-token recomputation from scratch (cache path == fresh
+        forward at every length)."""
+        from fei_tpu.engine import GenerationConfig, InferenceEngine
+        from fei_tpu.models.llama import KVCache, forward
+
+        eng = InferenceEngine.from_config(
+            "tiny-swa", tokenizer="byte", max_seq_len=48, dtype=jnp.float32
+        )
+        assert eng.cfg.sliding_window == 8
+        ids = eng.tokenizer.encode("sliding window probe text")
+        gen = GenerationConfig(max_new_tokens=10, temperature=0.0, ignore_eos=True)
+        got = eng.generate(ids, gen).token_ids
+
+        # from-scratch argmax chain (full forward each step, same window)
+        cur = list(ids)
+        want = []
+        for _ in range(10):
+            cache = KVCache.create(eng.cfg, 1, 48, jnp.float32)
+            logits, _ = forward(
+                eng.params, eng.cfg, jnp.asarray([cur], jnp.int32), cache
+            )
+            nxt = int(jnp.argmax(logits[0, len(cur) - 1]))
+            want.append(nxt)
+            cur.append(nxt)
+        assert got == want
+
+    def test_paged_rejected(self):
+        from fei_tpu.engine import InferenceEngine
+        from fei_tpu.utils.errors import EngineError
+
+        with pytest.raises(EngineError, match="sliding-window"):
+            InferenceEngine.from_config("tiny-swa", paged=True, batch_size=2)
+
+
+class TestHFWindowMerge:
+    """Config-merge rules for sliding_window (engine/weights.py)."""
+
+    def _merge(self, tmp_path, hf_cfg: dict):
+        import json
+
+        from fei_tpu.engine.weights import _merge_hf_config
+        from fei_tpu.models.configs import get_model_config
+
+        (tmp_path / "config.json").write_text(json.dumps(hf_cfg))
+        return _merge_hf_config(str(tmp_path), get_model_config("mistral-7b"))
+
+    def test_mistral_null_disables_preset_window(self, tmp_path):
+        """Mistral v0.2+ sets sliding_window: null — it must OVERRIDE the
+        preset's v0.1 default of 4096, not be dropped by the None-filter."""
+        cfg = self._merge(
+            tmp_path, {"model_type": "mistral", "sliding_window": None}
+        )
+        assert cfg.sliding_window is None
+
+    def test_mistral_v01_window_adopted(self, tmp_path):
+        cfg = self._merge(
+            tmp_path, {"model_type": "mistral", "sliding_window": 4096}
+        )
+        assert cfg.sliding_window == 4096
+
+    def test_qwen2_full_coverage_means_no_window(self, tmp_path):
+        """HF Qwen2 defaults max_window_layers == num_layers: SWA applies
+        to zero layers even with use_sliding_window=true."""
+        cfg = self._merge(tmp_path, {
+            "model_type": "qwen2", "use_sliding_window": True,
+            "sliding_window": 128, "max_window_layers": 4,
+            "num_hidden_layers": 4,
+        })
+        assert cfg.sliding_window is None
+
+    def test_qwen2_partial_windowing_rejected(self, tmp_path):
+        from fei_tpu.utils.errors import CheckpointError
+
+        with pytest.raises(CheckpointError, match="max_window_layers"):
+            self._merge(tmp_path, {
+                "model_type": "qwen2", "use_sliding_window": True,
+                "sliding_window": 128, "max_window_layers": 2,
+                "num_hidden_layers": 4,
+            })
+
+
+transformers = pytest.importorskip("transformers")
+torch = pytest.importorskip("torch")
+
+
+class TestMistralParity:
+    def test_logits_match_with_window_biting(self, tmp_path):
+        """Golden parity vs HF MistralForCausalLM with sliding_window=4 at
+        sequence length 10 — the window truncates most rows, so full-causal
+        attention CANNOT pass this."""
+        cfg_hf = transformers.MistralConfig(
+            vocab_size=256, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=3, num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=128, rope_theta=10000.0,
+            rms_norm_eps=1e-5, sliding_window=4,
+        )
+        torch.manual_seed(3)
+        model = transformers.MistralForCausalLM(cfg_hf).eval()
+        model.save_pretrained(str(tmp_path), safe_serialization=True)
+
+        from fei_tpu.engine.weights import load_checkpoint
+        from fei_tpu.models.configs import get_model_config
+        from fei_tpu.models.llama import KVCache, forward
+
+        ids = np.array([[3, 9, 44, 101, 7, 250, 16, 8, 77, 30]], np.int64)
+        with torch.no_grad():
+            want = model(torch.from_numpy(ids)).logits.float().numpy()
+
+        cfg = get_model_config("tiny")
+        cfg2, params = load_checkpoint(str(tmp_path), cfg, dtype=jnp.float32)
+        assert cfg2.sliding_window == 4
+
+        cache = KVCache.create(cfg2, 1, ids.shape[1], jnp.float32)
+        got, _ = forward(params, cfg2, jnp.asarray(ids, jnp.int32), cache)
+        np.testing.assert_allclose(
+            np.asarray(got)[0], want[0], atol=2e-3
+        )
