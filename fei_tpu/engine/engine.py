@@ -122,12 +122,6 @@ class InferenceEngine:
                 "kv_quant requires paged=True (the contiguous KVCache path "
                 "has no quantized variant)"
             )
-        if model_cfg.sliding_window and paged:
-            raise EngineError(
-                "sliding-window models serve through the dense-cache engine "
-                "this round (the paged kernels have no window mask yet) — "
-                "construct without paged=True"
-            )
         self.kv_quant = kv_quant
         # opt-in (vLLM-style): shared page-aligned prompt prefixes are
         # cached and reused across requests by the scheduler

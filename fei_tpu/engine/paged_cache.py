@@ -356,6 +356,7 @@ def paged_attention_reference(
     lengths: jnp.ndarray,  # [B]
     k_scales: jnp.ndarray | None = None,  # [P, K, 1, ps]
     v_scales: jnp.ndarray | None = None,
+    window: int = 0,
 ) -> jnp.ndarray:
     """Gather-based XLA oracle for the Pallas paged kernel (tests).
     int8 pools dequantize in the gathered view."""
@@ -375,5 +376,6 @@ def paged_attention_reference(
     vc = jnp.moveaxis(vg, 2, 3).reshape(B, S, K, D)
     positions = (lengths - 1)[:, None]
     return attention(
-        q[:, None], kc.astype(q.dtype), vc.astype(q.dtype), positions, lengths
+        q[:, None], kc.astype(q.dtype), vc.astype(q.dtype), positions, lengths,
+        window=window,
     )[:, 0]

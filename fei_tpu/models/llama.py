@@ -503,6 +503,7 @@ def forward_paged_block(
     # kernel-selection policy: see the docstring
     block_kernel = T > 1 and os.environ.get("FEI_TPU_BLOCK_ATTN", "1") != "0"
     sharded = kernel_mesh is not None and kernel_mesh.shape.get("tp", 1) > 1
+    win = cfg.sliding_window or 0
 
     kv_int8 = cache.k_scales is not None
     dtype = model_dtype(params) if kv_int8 else cache.k_pages.dtype
@@ -535,11 +536,12 @@ def forward_paged_block(
                 attn = paged_attention_block_sharded(
                     q, kp, vp, cache.block_table, cache.lengths,
                     kernel_mesh, axis_name="tp", k_scales=ksc, v_scales=vsc,
+                    window=win,
                 )
             else:
                 attn = paged_attention_block(
                     q, kp, vp, cache.block_table, cache.lengths,
-                    k_scales=ksc, v_scales=vsc,
+                    k_scales=ksc, v_scales=vsc, window=win,
                 )  # [B, T, Hq, D]
         else:
             attns = []
@@ -548,12 +550,13 @@ def forward_paged_block(
                     a = paged_attention_sharded(
                         q[:, i], kp, vp, cache.block_table,
                         cache.lengths + i + 1, kernel_mesh, axis_name="tp",
-                        k_scales=ksc, v_scales=vsc,
+                        k_scales=ksc, v_scales=vsc, window=win,
                     )
                 else:
                     a = paged_attention(
                         q[:, i], kp, vp, cache.block_table,
                         cache.lengths + i + 1, k_scales=ksc, v_scales=vsc,
+                        window=win,
                     )  # [B, Hq, D]
                 attns.append(a)
             attn = jnp.stack(attns, axis=1)  # [B, T, Hq, D]
