@@ -191,6 +191,19 @@ class PageAllocator:
                 return run
         return None
 
+    def release_prefix(self, seq_id: int, n: int) -> list[int]:
+        """Drop the sequence's first ``n`` owned pages (rolling-buffer
+        sliding-window serving: positions below every future query's
+        window are never attended again — the kernel's index maps clamp
+        past them — so their pages return to the pool while the sequence
+        is still live). Shared prefix-cache pages just lose this
+        sequence's reference; the registry's own ref keeps them alive.
+        Returns the released page ids."""
+        owned = self._owned.get(seq_id, [])
+        drop, self._owned[seq_id] = owned[:n], owned[n:]
+        self.drop_ref(drop)
+        return drop
+
     def free(self, seq_id: int) -> None:
         self.drop_ref(list(reversed(self._owned.pop(seq_id, []))))
 

@@ -88,6 +88,9 @@ class _Seq:
     # host-mask fallback state (second distinct grammar in flight): the
     # toolcall masker's dict, whose "accepted" flag folds into gaccepted
     gfallback_state: dict | None = None
+    # rolling-buffer SWA: count of leading pages already released back to
+    # the pool (positions below every future query's sliding window)
+    released_pages: int = 0
 
 
 class PagedScheduler:
@@ -974,8 +977,29 @@ class PagedScheduler:
             self._finish(seq)
             return
         seq.next_input = t
+        if self.engine.cfg.sliding_window:
+            self._release_window_pages(seq)
         if len(seq.generated) >= seq.budget:
             self._finish(seq)
+
+    def _release_window_pages(self, seq: _Seq) -> None:
+        """Rolling-buffer SWA: pages wholly below (pos - window - margin)
+        return to the pool mid-stream — the decode kernels' index maps
+        clamp past them, so they are never read OR DMA'd again. The margin
+        covers speculation rollback (a rejected draft shrinks the length by
+        at most the draft; a page released under the longer length must
+        still be below the window after the shrink) plus one page of
+        slack for the multi-token block writes."""
+        W = self.engine.cfg.sliding_window
+        ps = self.engine.page_size
+        margin = self.spec_draft_len + ps
+        cur = len(seq.prompt_ids) + len(seq.generated)
+        releasable = max(0, (cur - W - margin)) // ps
+        if releasable > seq.released_pages:
+            n = releasable - seq.released_pages
+            self.engine._allocator.release_prefix(seq.slot, n)
+            seq.released_pages = releasable
+            METRICS.incr("scheduler.swa_pages_released", n)
 
     def _maybe_spec_step(self) -> bool:
         """Prompt-lookup speculation inside the scheduler: when exactly one
@@ -1017,7 +1041,13 @@ class PagedScheduler:
         # pool length for the slot: prompt + generated, minus the pending
         # next_input whose KV is written when it is fed
         L0 = len(s.prompt_ids) + len(s.generated) - 1
-        room = len(eng._allocator.pages_for(b)) * eng.page_size
+        # room is ABSOLUTE top-end capacity: rolling-buffer SWA releases
+        # drop leading pages from pages_for, but the slot's reserved high
+        # positions are unchanged — count the released pages back in or
+        # long SWA streams silently lose speculation mid-stream
+        room = (
+            s.released_pages + len(eng._allocator.pages_for(b))
+        ) * eng.page_size
         if L0 + T > min(room, eng.max_seq_len):
             return False
         draft = draft + [0] * (self.spec_draft_len - len(draft))
