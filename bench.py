@@ -85,16 +85,68 @@ def _prompt(engine):
     return engine.tokenizer.encode(text, add_bos=True)[:128]
 
 
-def _emit(metric: str, value: float, unit: str = "tok/s/chip") -> int:
-    if os.environ.get("FEI_TPU_BENCH_CPU_FALLBACK"):
-        # never let a CPU liveness number masquerade as a TPU measurement
-        metric = f"{metric}_CPU_FALLBACK_TPU_UNAVAILABLE"
-    print(json.dumps({
+STATE_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "onchip_state.json"
+)
+# BASELINE config #2's exact metric — the ONLY one that owns the headline
+# slot (an int4/moe decode stage must not displace the gate number)
+GATE_METRIC = "llama3-8b-int8_decode_tok_s_per_chip"
+
+
+def _load_state() -> dict:
+    try:
+        with open(STATE_PATH) as f:
+            return json.load(f)
+    except Exception:  # noqa: BLE001 — missing/corrupt state must not sink
+        return {}
+
+
+def _record_onchip(line: dict, extra: dict | None) -> None:
+    """Persist a REAL on-chip measurement so later outages can still report
+    it (VERDICT r3 #1: the chip comes and goes; the driver snapshot must not
+    depend on the backend being up at that instant). Only called for
+    measurements taken on an actual TPU backend."""
+    entry = dict(line)
+    if extra:
+        entry.update(extra)
+    entry["ts"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    try:
+        import jax
+
+        entry["device"] = jax.devices()[0].device_kind
+    except Exception:  # noqa: BLE001
+        pass
+    state = _load_state()
+    state.setdefault("suites", {})[line["metric"]] = entry
+    # the headline slot tracks the BASELINE config #2 gate metric; any other
+    # suite only lands there if no gate result exists yet
+    if line["metric"] == GATE_METRIC or not state.get("last_onchip"):
+        state["last_onchip"] = entry
+    tmp = STATE_PATH + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(state, f, indent=1, sort_keys=True)
+    os.replace(tmp, STATE_PATH)  # atomic: a mid-write kill can't truncate
+
+
+def _emit(metric: str, value: float, unit: str = "tok/s/chip",
+          extra: dict | None = None) -> int:
+    line = {
         "metric": metric,
         "value": round(value, 2),
         "unit": unit,
         "vs_baseline": round(value / 20.0, 3),
-    }), flush=True)
+    }
+    if os.environ.get("FEI_TPU_BENCH_CPU_FALLBACK"):
+        # never let a CPU liveness number masquerade as a TPU measurement —
+        # but DO carry the last real on-chip result as structured metadata
+        # so the driver artifact records it even through an outage
+        line["metric"] = f"{metric}_CPU_FALLBACK_TPU_UNAVAILABLE"
+        last = _load_state().get("last_onchip")
+        if last:
+            line["last_onchip"] = last
+    elif os.environ.get("FEI_TPU_BENCH_ONCHIP"):
+        _record_onchip(line, extra)
+    print(json.dumps(line), flush=True)
     return 0
 
 
@@ -276,7 +328,8 @@ def bench_decode(model: str, n_tokens: int) -> int:
         f"({flops_per_tok/1e9:.1f} GFLOPs/token @ 197 TFLOP/s bf16 peak)")
     quant = os.environ.get("FEI_TPU_BENCH_QUANT")
     tag = f"{model}-{quant}" if quant else model
-    return _emit(f"{tag}_decode_tok_s_per_chip", tok_s)
+    return _emit(f"{tag}_decode_tok_s_per_chip", tok_s,
+                 extra={"ttft_ms": round(ttft_p50 * 1000, 1)})
 
 
 def bench_prefill(model: str, n_tokens: int) -> int:
@@ -324,7 +377,8 @@ def bench_prefill(model: str, n_tokens: int) -> int:
     engine.close()
     quant = os.environ.get("FEI_TPU_BENCH_QUANT")
     tag = f"{model}-{quant}" if quant else model
-    return _emit(f"{tag}_prefill{plen}_tok_s_per_chip", plen / p50)
+    return _emit(f"{tag}_prefill{plen}_tok_s_per_chip", plen / p50,
+                 extra={"ttft_ms": round(p50 * 1000, 1)})
 
 
 def bench_paged(model: str, n_tokens: int) -> int:
@@ -415,8 +469,19 @@ def bench_paged(model: str, n_tokens: int) -> int:
         log(f"bench: paged run {run}: {sum(counts)} tokens in {dt:.1f}s "
             f"-> {agg:.1f} tok/s aggregate")
         best = max(best, agg)
+    quant = os.environ.get("FEI_TPU_BENCH_QUANT")
+    kv = os.environ.get("FEI_TPU_BENCH_KV_QUANT")
+    tag = f"{model}-{quant}" if quant else model
+    if kv:
+        tag += f"-kv{kv}"
+    ms = os.environ.get("FEI_TPU_SCHED_MULTISTEP")
+    if ms:  # A/B runs must not collide with the default metric
+        tag += f"-ms{ms}"
+    sp = os.environ.get("FEI_TPU_SPECULATE")
+    if sp is not None:  # both arms of the spec A/B must persist
+        tag += f"-spec{sp}"
     return _emit(
-        f"{model}_paged_{streams}stream_agg_tok_s_per_chip", best
+        f"{tag}_paged_{streams}stream_agg_tok_s_per_chip", best
     )
 
 
@@ -590,7 +655,7 @@ def bench_agent(model: str, n_tokens: int) -> int:
             # summed across tool rounds by Assistant.chat, so multi-round
             # turns don't under-report
             toks = assistant.last_usage.get("completion_tokens", 0)
-            return toks, dt
+            return toks, dt, provider.last_ttft_s
 
         log("bench: agent warm-up (compile)...")
         turn()
@@ -606,13 +671,25 @@ def bench_agent(model: str, n_tokens: int) -> int:
         retry = True
     if retry:
         turn = build()
-    best = 0.0
+    best, ttfts = 0.0, []
     for run in range(3):
-        toks, dt = turn()
+        toks, dt, ttft = turn()
         rate = toks / dt if dt > 0 else 0.0
-        log(f"bench: agent run {run}: {toks} tokens in {dt:.1f}s -> {rate:.1f} tok/s")
+        if ttft is not None:
+            ttfts.append(ttft)
+        log(f"bench: agent run {run}: {toks} tokens in {dt:.1f}s -> "
+            f"{rate:.1f} tok/s"
+            + (f", ttft={ttft*1000:.1f}ms" if ttft is not None else ""))
         best = max(best, rate)
-    return _emit(f"{model}_agent_e2e_tok_s_per_chip", best)
+    extra = None
+    if ttfts:
+        p50 = sorted(ttfts)[len(ttfts) // 2]
+        log(f"bench: agent p50 ttft={p50*1000:.1f}ms (first visible token "
+            "through template+provider+engine)")
+        extra = {"ttft_ms": round(p50 * 1000, 1)}
+    quant = os.environ.get("FEI_TPU_BENCH_QUANT")
+    tag = f"{model}-{quant}" if quant else model
+    return _emit(f"{tag}_agent_e2e_tok_s_per_chip", best, extra=extra)
 
 
 def main() -> int:
@@ -667,6 +744,10 @@ def main() -> int:
     if os.environ.get("FEI_TPU_BENCH_CPU_FALLBACK"):
         model = os.environ["FEI_TPU_BENCH_MODEL"]  # shrunk to 'tiny'
         n_tokens = min(n_tokens, 32)
+    elif backend == "tpu":
+        # a real chip measurement: persist it so later outages still report
+        # it (see _record_onchip)
+        os.environ["FEI_TPU_BENCH_ONCHIP"] = "1"
     log(f"bench: suite={suite} model={model} backend={backend} devices={devices}")
 
     if suite == "prefill":

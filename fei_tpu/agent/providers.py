@@ -24,6 +24,7 @@ from __future__ import annotations
 import json
 import os
 import re
+import time
 import uuid
 from dataclasses import dataclass, field
 from typing import Any, Sequence
@@ -243,6 +244,7 @@ class JaxLocalProvider(Provider):
         self.constrain_tools = cfg.get_bool("jax_local", "constrain_tools", True)
         self.tool_trigger = cfg.get("jax_local", "tool_trigger", _OPEN_TAG)
         self._grammar_cache: dict = {}
+        self.last_ttft_s: float | None = None  # set per stream() call
 
     def _tool_grammar(self, tools: list[dict] | None):
         """Registry-union TokenGrammar for ``tools``, memoized per schema
@@ -361,8 +363,14 @@ class JaxLocalProvider(Provider):
             stream_fn = self.engine.generate_stream_lookahead
         else:
             stream_fn = self.engine.generate_stream
+        t_start = time.perf_counter()
         with METRICS.span("provider.jax_local"):
             for tok in stream_fn(ids, gen):
+                if not out_ids:
+                    # agent-level TTFT: prefill + first decode step, measured
+                    # at the provider boundary (the BASELINE metric is TTFT
+                    # for `fei --message`, not raw engine TTFT)
+                    self.last_ttft_s = time.perf_counter() - t_start
                 out_ids.append(tok)
                 pending.append(tok)
                 ctx_text = self.engine.tokenizer.decode(ctx) if ctx else ""

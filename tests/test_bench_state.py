@@ -1,0 +1,95 @@
+"""The on-chip record must be outage-proof (VERDICT r3 #1): real TPU
+measurements persist to onchip_state.json, and the CPU-fallback bench line
+carries the last on-chip result as structured metadata so a backend outage
+at snapshot time can no longer erase the record from the driver artifact."""
+
+import importlib.util
+import json
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture()
+def bench(monkeypatch, tmp_path):
+    spec = importlib.util.spec_from_file_location("bench", REPO / "bench.py")
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["bench"] = mod
+    spec.loader.exec_module(mod)
+    monkeypatch.setattr(mod, "STATE_PATH", str(tmp_path / "state.json"))
+    monkeypatch.delenv("FEI_TPU_BENCH_CPU_FALLBACK", raising=False)
+    monkeypatch.delenv("FEI_TPU_BENCH_ONCHIP", raising=False)
+    yield mod
+    sys.modules.pop("bench", None)
+
+
+def _last_line(capsys):
+    return json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+
+
+def test_onchip_emit_persists_state(bench, monkeypatch, capsys):
+    monkeypatch.setenv("FEI_TPU_BENCH_ONCHIP", "1")
+    bench._emit("m-8b-int8_decode_tok_s_per_chip", 71.81,
+                extra={"ttft_ms": 164.1})
+    line = _last_line(capsys)
+    assert line["metric"] == "m-8b-int8_decode_tok_s_per_chip"
+    state = json.loads(Path(bench.STATE_PATH).read_text())
+    rec = state["last_onchip"]
+    assert rec["value"] == 71.81
+    assert rec["ttft_ms"] == 164.1
+    assert "ts" in rec
+    assert state["suites"]["m-8b-int8_decode_tok_s_per_chip"] == rec
+
+
+def test_gate_metric_owns_headline_slot(bench, monkeypatch, capsys):
+    monkeypatch.setenv("FEI_TPU_BENCH_ONCHIP", "1")
+    bench._emit(bench.GATE_METRIC, 70.0)
+    # later pipeline stages — paged, moe decode, int4 decode — are recorded
+    # but must NOT displace the gate number from the headline slot
+    bench._emit("llama3-1b_paged_4stream_agg_tok_s_per_chip", 175.0)
+    bench._emit("moe-2b_decode_tok_s_per_chip", 141.9)
+    bench._emit("llama3-8b-int4_decode_tok_s_per_chip", 100.0)
+    state = json.loads(Path(bench.STATE_PATH).read_text())
+    assert state["last_onchip"]["metric"] == bench.GATE_METRIC
+    assert state["last_onchip"]["value"] == 70.0
+    assert len(state["suites"]) == 4
+    bench._emit(bench.GATE_METRIC, 72.0)
+    state = json.loads(Path(bench.STATE_PATH).read_text())
+    assert state["last_onchip"]["value"] == 72.0
+
+
+def test_cpu_fallback_carries_last_onchip(bench, monkeypatch, capsys):
+    monkeypatch.setenv("FEI_TPU_BENCH_ONCHIP", "1")
+    bench._emit(bench.GATE_METRIC, 71.81)
+    capsys.readouterr()
+    monkeypatch.delenv("FEI_TPU_BENCH_ONCHIP")
+    monkeypatch.setenv("FEI_TPU_BENCH_CPU_FALLBACK", "1")
+    bench._emit("tiny_decode_tok_s_per_chip", 239.4)
+    line = _last_line(capsys)
+    assert line["metric"].endswith("_CPU_FALLBACK_TPU_UNAVAILABLE")
+    assert line["last_onchip"]["value"] == 71.81
+    # the fallback line itself must never be recorded as an on-chip result
+    state = json.loads(Path(bench.STATE_PATH).read_text())
+    assert "tiny" not in json.dumps(state)
+
+
+def test_fallback_without_state_still_emits(bench, monkeypatch, capsys):
+    monkeypatch.setenv("FEI_TPU_BENCH_CPU_FALLBACK", "1")
+    bench._emit("tiny_decode_tok_s_per_chip", 1.0)
+    line = _last_line(capsys)
+    assert "last_onchip" not in line
+
+
+def test_committed_state_carries_gate():
+    """The committed state file must always hold A gate measurement — the
+    round-3 seed (71.81) or any later on-chip refresh — above the 20 tok/s
+    floor, taken on a real TPU."""
+    state = json.loads((REPO / "onchip_state.json").read_text())
+    rec = state["last_onchip"]
+    assert rec["metric"] == "llama3-8b-int8_decode_tok_s_per_chip"
+    assert rec["value"] >= 20.0  # the BASELINE north-star floor
+    assert rec["device"].startswith("TPU")
+    assert "ts" in rec and "ttft_ms" in rec
