@@ -706,8 +706,13 @@ def _merge_hf_config(ckpt_dir: str, cfg: ModelConfig) -> ModelConfig:
         # zero layers). The scan-stacked decoder has one uniform window, so
         # partial per-layer windowing is rejected loudly rather than
         # silently mis-windowing every layer.
-        mwl = hf.get("max_window_layers", 0) or 0
         n_layers = hf.get("num_hidden_layers", 0) or 0
+        # HF Qwen2Config defaults max_window_layers to num_hidden_layers
+        # (SWA on zero layers) — an ABSENT key must inherit that default,
+        # not 0, or the config would silently window every layer. An
+        # explicit 0 remains the all-layers opt-in.
+        mwl = hf.get("max_window_layers")
+        mwl = n_layers if mwl is None else mwl
         if mwl >= n_layers:
             fields["sliding_window"] = None
         elif mwl == 0:

@@ -150,6 +150,35 @@ def _round_up(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
 
 
+def _kv_index_map(block_q: int, block_k: int, groups: int, window: int):
+    """K/V BlockSpec index map with dead-tile elision: k tiles entirely
+    below the window (SWA) or entirely above the causal diagonal / past the
+    valid prefix are CLAMPED to the nearest live tile index. Pallas elides
+    the block copy when consecutive grid steps map the same index, so dead
+    tiles are never DMA'd from HBM — without this, a 32k-context/4k-window
+    dense SWA prefill streams the full KV despite pl.when skipping the math
+    (mirrors _page_idx in paged_attention.py). Compute on dead tiles is
+    already predicated off, so the clamped tile's data is never read."""
+
+    def idx(b, h, qi, ki, q_start, kv_len):
+        q_first = q_start[b] + qi * block_q
+        # last live tile: causal diagonal of the tile's LAST query, capped
+        # at the final valid-prefix tile
+        last = jnp.minimum(
+            (q_first + block_q - 1) // block_k,
+            jnp.maximum((kv_len[b] - 1) // block_k, 0),
+        )
+        if window:
+            # first tile holding any position inside the FIRST query's
+            # window (its window reaches furthest back)
+            first = jnp.maximum((q_first - window + 1) // block_k, 0)
+        else:
+            first = 0
+        return (b, h // groups, jnp.clip(ki, first, jnp.maximum(last, first)), 0)
+
+    return idx
+
+
 def _resolve_blocks(T: int, S: int, block_q: int, block_k: int):
     # Mosaic tiling: sublane (second-to-last) dim must be a multiple of 8
     block_q = max(8, min(block_q, _round_up(T, 8)))
@@ -189,6 +218,7 @@ def _fwd_impl(
         save_lse=save_lse,
     )
 
+    kv_idx = _kv_index_map(block_q, block_k, groups, window)
     outs = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
@@ -199,14 +229,8 @@ def _fwd_impl(
                     (1, 1, block_q, D),
                     lambda b, h, qi, ki, *_: (b, h, qi, 0),
                 ),
-                pl.BlockSpec(
-                    (1, 1, block_k, D),
-                    lambda b, h, qi, ki, *_: (b, h // groups, ki, 0),
-                ),
-                pl.BlockSpec(
-                    (1, 1, block_k, D),
-                    lambda b, h, qi, ki, *_: (b, h // groups, ki, 0),
-                ),
+                pl.BlockSpec((1, 1, block_k, D), kv_idx),
+                pl.BlockSpec((1, 1, block_k, D), kv_idx),
             ],
             out_specs=[
                 pl.BlockSpec(
@@ -433,8 +457,10 @@ def _bwd_impl(
     q_spec = pl.BlockSpec(
         (1, 1, block_q, D), lambda b, h, i, j, *_: (b, h, i, 0)
     )
+    # dq shares the fwd grid geometry (k blocks innermost) — reuse the
+    # dead-tile-eliding index map so SWA backward doesn't stream dead KV
     kv_spec_q = pl.BlockSpec(
-        (1, 1, block_k, D), lambda b, h, i, j, *_: (b, h // groups, j, 0)
+        (1, 1, block_k, D), _kv_index_map(block_q, block_k, groups, window)
     )
     row_spec = pl.BlockSpec(
         (1, 1, block_q, _LANES), lambda b, h, i, j, *_: (b, h, i, 0)

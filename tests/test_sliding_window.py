@@ -299,6 +299,24 @@ class TestHFWindowMerge:
         })
         assert cfg.sliding_window is None
 
+    def test_qwen2_absent_mwl_inherits_hf_default(self, tmp_path):
+        """A config.json that relies on HF Qwen2Config's max_window_layers
+        default (== num_hidden_layers) must get the SAME semantics as an
+        explicit value: no window — NOT all-layers windowing (ADVICE r3)."""
+        cfg = self._merge(tmp_path, {
+            "model_type": "qwen2", "use_sliding_window": True,
+            "sliding_window": 128, "num_hidden_layers": 4,
+        })
+        assert cfg.sliding_window is None
+
+    def test_qwen2_explicit_zero_windows_all_layers(self, tmp_path):
+        cfg = self._merge(tmp_path, {
+            "model_type": "qwen2", "use_sliding_window": True,
+            "sliding_window": 128, "max_window_layers": 0,
+            "num_hidden_layers": 4,
+        })
+        assert cfg.sliding_window == 128
+
     def test_qwen2_partial_windowing_rejected(self, tmp_path):
         from fei_tpu.utils.errors import CheckpointError
 
