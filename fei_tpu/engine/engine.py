@@ -603,9 +603,6 @@ class InferenceEngine:
             or "sp" not in self.mesh.axis_names
             or self.mesh.shape["sp"] <= 1
             or n_tokens < self.long_prefill_min
-            # ring/ulysses shards have no sliding-window mask yet; SWA
-            # prompts stay on the (window-correct) dense path
-            or self.cfg.sliding_window
         ):
             return False
         bucket = min(_next_bucket(n_tokens), self.max_seq_len)

@@ -30,11 +30,16 @@ from jax.sharding import Mesh, PartitionSpec as P
 NEG_INF = -1e30
 
 
-def _block_attend(q, k, v, q_pos, k_pos, scale):
+def _block_attend(q, k, v, q_pos, k_pos, scale, window: int = 0):
     """One (q-block × kv-block) online-softmax contribution.
 
     q: [B, Tq, H, D]; k/v: [B, Sk, K, D]; positions: [Tq] / [Sk] absolute.
     Returns (m, l, acc) partials: m/l [B, Tq, H, 1], acc [B, Tq, H, D].
+    ``window`` adds the sliding-window mask (key visible iff additionally
+    k_pos > q_pos - window). An entirely-masked visiting block produces
+    m = NEG_INF partials whose contributions the caller's online-softmax
+    correction zeroes once any live block has been folded — and causally
+    every query row's own chunk (fold step 0) is always live.
     """
     B, Tq, H, D = q.shape
     K = k.shape[2]
@@ -47,6 +52,11 @@ def _block_attend(q, k, v, q_pos, k_pos, scale):
     mask = (
         k_pos[None, None, None, None, :] <= q_pos[None, :, None, None, None]
     )
+    if window:
+        mask &= (
+            k_pos[None, None, None, None, :]
+            > q_pos[None, :, None, None, None] - window
+        )
     s = jnp.where(mask, s, NEG_INF)
 
     m = jnp.max(s, axis=-1, keepdims=True)  # [B, Tq, K, G, 1]
@@ -60,12 +70,17 @@ def _block_attend(q, k, v, q_pos, k_pos, scale):
     )
 
 
-def _ring_attention_shard(q, k, v, *, axis_name: str, scale: float):
+def _ring_attention_shard(
+    q, k, v, *, axis_name: str, scale: float, window: int = 0
+):
     """Per-shard ring attention body (runs under shard_map).
 
     q/k/v: this device's sequence chunk [B, C, H|K, D]. K/V chunks rotate
     ring-wise; each arrival is folded into the running (m, l, acc) softmax
-    state. Chunk c holds absolute positions [c·C, (c+1)·C).
+    state. Chunk c holds absolute positions [c·C, (c+1)·C). ``window``
+    applies the sliding-window mask with the same absolute positions, so
+    chunks entirely below a row's window contribute nothing (the online
+    correction zeroes them; see _block_attend).
     """
     B, C, H, D = q.shape
     n = jax.lax.psum(1, axis_name)
@@ -85,7 +100,9 @@ def _ring_attention_shard(q, k, v, *, axis_name: str, scale: float):
         # after `step` rotations we hold the chunk originally on idx - step
         src = (my_idx - step) % n
         k_pos = src * C + jnp.arange(C)
-        bm, bl, bacc = _block_attend(q, k_cur, v_cur, q_pos, k_pos, scale)
+        bm, bl, bacc = _block_attend(
+            q, k_cur, v_cur, q_pos, k_pos, scale, window=window
+        )
 
         m_new = jnp.maximum(m, bm)
         c_old = jnp.exp(m - m_new)
@@ -97,7 +114,18 @@ def _ring_attention_shard(q, k, v, *, axis_name: str, scale: float):
         v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
         return k_nxt, v_nxt, m_new, l, acc
 
-    _, _, m, l, acc = jax.lax.fori_loop(0, n, body, (k, v, m0, l0, acc0))
+    # with a window, chunks more than ceil((window-1)/C) hops back are
+    # entirely masked for EVERY query on this device (k_last < q_first -
+    # window for hop h when h*C >= window + C - 1), so the rotation stops
+    # early: Mistral-shape prefill (window=4096, 32k prompt, sp=8) attends
+    # 2 of 8 chunks instead of masking 6 to zero. The count is static and
+    # uniform across devices (C and window are trace-time constants);
+    # wrapped steps beyond n-1 are causally dead anyway.
+    steps = n
+    if window:
+        # fori_loop's trip count must be a Python int: C = T // n is static
+        steps = min(n, 1 + (window + C - 2) // C)
+    _, _, m, l, acc = jax.lax.fori_loop(0, steps, body, (k, v, m0, l0, acc0))
     # fully-masked rows (can't happen causally: position p always sees p) —
     # still guard the division for safety
     safe_l = jnp.where(l == 0.0, 1.0, l)
@@ -111,18 +139,24 @@ def ring_attention(
     mesh: Mesh,
     axis_name: str = "sp",
     scale: float | None = None,
+    window: int = 0,
 ) -> jnp.ndarray:
     """Causal self-attention with the sequence sharded over ``axis_name``.
 
     T must divide evenly over the axis. Suitable for long-prompt prefill;
-    output is sequence-sharded the same way as the input.
+    output is sequence-sharded the same way as the input. ``window`` (> 0)
+    applies sliding-window attention — same contract as the dense oracle
+    (ops.attention): key s visible iff s <= p and s > p - window.
     """
     D = q.shape[-1]
     if scale is None:
         scale = D ** -0.5
     spec = P(None, axis_name, None, None)
     fn = jax.shard_map(
-        functools.partial(_ring_attention_shard, axis_name=axis_name, scale=scale),
+        functools.partial(
+            _ring_attention_shard, axis_name=axis_name, scale=scale,
+            window=window,
+        ),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
@@ -130,7 +164,7 @@ def ring_attention(
     return fn(q, k, v)
 
 
-def _ulysses_shard(q, k, v, *, axis_name: str, scale: float):
+def _ulysses_shard(q, k, v, *, axis_name: str, scale: float, window: int = 0):
     """Per-shard Ulysses body: all_to_all seq→head reshard, local full
     attention over the complete sequence for a head slice, reshard back.
 
@@ -154,7 +188,7 @@ def _ulysses_shard(q, k, v, *, axis_name: str, scale: float):
     qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
     T = qh.shape[1]
     pos = jnp.arange(T)
-    m, l, acc = _block_attend(qh, kh, vh, pos, pos, scale)
+    m, l, acc = _block_attend(qh, kh, vh, pos, pos, scale, window=window)
     out = (acc / jnp.where(l == 0.0, 1.0, l)).astype(q.dtype)
     return heads_to_seq(out)
 
@@ -166,9 +200,12 @@ def ulysses_attention(
     mesh: Mesh,
     axis_name: str = "sp",
     scale: float | None = None,
+    window: int = 0,
 ) -> jnp.ndarray:
     """Causal attention via head↔sequence all_to_all (DeepSpeed-Ulysses
-    style). Needs H % n == 0 and K % n == 0 for the head scatter."""
+    style). Needs H % n == 0 and K % n == 0 for the head scatter.
+    ``window`` (> 0) applies the sliding-window mask (dense-oracle
+    contract)."""
     D = q.shape[-1]
     n = mesh.shape[axis_name]
     H, K = q.shape[2], k.shape[2]
@@ -180,7 +217,9 @@ def ulysses_attention(
         scale = D ** -0.5
     spec = P(None, axis_name, None, None)
     fn = jax.shard_map(
-        functools.partial(_ulysses_shard, axis_name=axis_name, scale=scale),
+        functools.partial(
+            _ulysses_shard, axis_name=axis_name, scale=scale, window=window
+        ),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,

@@ -92,10 +92,12 @@ stage bench_8b_paged_8s env FEI_TPU_BENCH_SUITE=paged \
   FEI_TPU_BENCH_KV_QUANT=int8 FEI_TPU_BENCH_STREAMS=8 \
   FEI_TPU_BENCH_MAX_WAIT_S=300 python -u bench.py
 
-# 4. int4 on-chip: kernel tests, then the 8B int4 decode bench
-# (RESOURCE_EXHAUSTED in r3's window; r4 added a diagnosis — VERDICT #3)
+# 4. int4 on-chip: kernel tests, the layer-ladder OOM diagnosis (VERDICT
+# r3 #3: 8B int4 RESOURCE_EXHAUSTED with the kernel fine standalone),
+# then the 8B int4 decode bench
 stage int4_tests env FEI_TPU_TEST_PLATFORM=tpu python -m pytest \
   tests/test_int4.py -q
+stage int4_diag python -u scripts/int4_diag.py
 stage bench_8b_int4 env FEI_TPU_BENCH_QUANT=int4 FEI_TPU_BENCH_MAX_WAIT_S=300 \
   python -u bench.py
 

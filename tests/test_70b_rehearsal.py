@@ -1,0 +1,151 @@
+"""70B end-to-end rehearsal on the virtual mesh (VERDICT r3 #7).
+
+SURVEY hard-part #4 (Llama-3-70B TP on v5e-64) gets its first full
+rehearsal: a 70B-SHAPED config — the REAL 80-layer depth and GQA ratio,
+hidden sizes scaled so the checkpoint stays CI-sized — runs the whole
+deployment path on 8 virtual CPU devices:
+
+  streamed sharded HF load (host RSS stays bounded; the property that lets
+  ~140 GB load onto a pod from a smaller host) -> one sharded DECODE step
+  with a KV cache on the tp mesh -> the 80-layer PIPELINED forward on a
+  tp x pp mesh (layers staged over pp, weights tp-sharded inside each
+  stage, numerically checked against the dense forward).
+
+Everything runs in one subprocess so the RSS high-water mark is clean
+(same methodology as test_streamed_load_rss.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from fei_tpu.models.configs import get_model_config
+
+safetensors = pytest.importorskip("safetensors.numpy")
+
+from tests.test_streamed_load import _write_hf_llama  # noqa: E402
+
+pytestmark = pytest.mark.slow
+
+# REAL 70B depth (80 layers) and REAL head counts (H=64, K=8 — the KV
+# cache shards kv heads over tp, so the true GQA geometry is what's being
+# rehearsed); hidden scaled 16x (8192 -> 512, head_dim 8) with the mlp
+# ratio kept at 3.5x, so the ~1 GB fp32 checkpoint gives an unambiguous
+# RSS signal while staying CI-sized
+_CFG_KW = dict(
+    num_layers=80, hidden_size=512, intermediate_size=1792,
+    num_heads=64, num_kv_heads=8, vocab_size=4096, max_seq_len=256,
+)
+
+_CHILD = r"""
+import gc, json, resource, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from fei_tpu.engine.weights import load_checkpoint
+from fei_tpu.models.configs import get_model_config
+from fei_tpu.models.llama import KVCache, forward, forward_train
+from fei_tpu.parallel.mesh import make_mesh
+from fei_tpu.parallel.pipeline import pipeline_forward_train
+from fei_tpu.parallel.sharding import (
+    cache_shardings, param_shardings, param_shardings_from_cfg,
+)
+
+ckpt, cfg_kw = sys.argv[1], json.loads(sys.argv[2])
+cfg = get_model_config("llama3-70b", **cfg_kw)
+report = {}
+
+def maxrss():
+    ru = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    return ru * 1024 if sys.platform.startswith("linux") else ru
+
+n = min(8, len(jax.devices()))
+tp_mesh = make_mesh({"tp": n}, devices=jax.devices()[:n])
+
+# --- streamed sharded load, clean RSS watermark
+gc.collect()
+wm0 = maxrss()
+_, params = load_checkpoint(
+    ckpt, cfg, dtype=jnp.float32,
+    shardings=param_shardings_from_cfg(cfg, tp_mesh),
+)
+jax.block_until_ready(params)
+report["pbytes"] = sum(
+    x.nbytes for x in jax.tree_util.tree_leaves(params)
+    if hasattr(x, "nbytes")
+)
+report["rss_delta"] = maxrss() - wm0
+
+# --- one sharded decode step: 80-layer prefill into a KV cache, then a
+# single-token step from it (the serving shape)
+cache = jax.device_put(
+    KVCache.create(cfg, 1, 64, dtype=jnp.float32), cache_shardings(tp_mesh, 1)
+)
+tokens = jnp.arange(1, 33, dtype=jnp.int32)[None, :]
+step = jax.jit(lambda p, t, c: forward(p, cfg, t, c), donate_argnums=(2,))
+logits, cache = step(params, tokens, cache)
+tok = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+logits2, cache = step(params, tok[:, None], cache)
+report["decode_finite"] = bool(np.isfinite(np.asarray(logits2)).all())
+report["decode_len"] = int(np.asarray(cache.length)[0])
+
+# --- 80 layers staged over pp with tp-sharded weights inside each stage,
+# checked against the dense forward on a short batch
+pp_mesh = make_mesh({"pp": 2, "tp": n // 2}, devices=jax.devices()[:n])
+params_pp = jax.device_put(params, param_shardings(params, pp_mesh, cfg.is_moe))
+toks = np.random.default_rng(0).integers(0, cfg.vocab_size, (2, 8)).astype(np.int32)
+want = forward_train(params, cfg, jnp.asarray(toks), remat=False)
+got = pipeline_forward_train(
+    params_pp, cfg, jnp.asarray(toks), pp_mesh, num_micro=2
+)
+np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-3)
+report["pp_matches_dense"] = True
+print(json.dumps(report))
+"""
+
+
+class Test70BRehearsal:
+    def test_70b_shaped_load_decode_and_pipeline(self, tmp_path):
+        cfg = get_model_config("llama3-70b", **_CFG_KW)
+        assert cfg.num_layers == 80  # the REAL depth is the point
+        _write_hf_llama(tmp_path, cfg)
+
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = (
+            env.get("XLA_FLAGS", "")
+            .replace("--xla_force_host_platform_device_count=8", "")
+            + " --xla_force_host_platform_device_count=8"
+        ).strip()
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        out = subprocess.run(
+            [sys.executable, "-c", _CHILD, str(tmp_path), json.dumps(_CFG_KW)],
+            capture_output=True, text=True, timeout=900, env=env, cwd=repo,
+        )
+        assert out.returncode == 0, out.stderr[-3000:]
+        rep = json.loads(out.stdout.strip().splitlines()[-1])
+
+        assert rep["pbytes"] > 8e8, (
+            f"model too small for signal: {rep['pbytes']/1e9:.2f} GB"
+        )
+        assert rep["decode_finite"], "70B-shaped decode produced non-finite"
+        assert rep["decode_len"] == 33  # 32 prefill + 1 step
+        assert rep["pp_matches_dense"]
+        # RSS budget (same bar as test_streamed_load_rss): bounded staging
+        # above the resident shards. Under memory pressure ru_maxrss loses
+        # attribution (near-zero growth for GBs of params) — then the cap
+        # is vacuously satisfied and the load/decode/pp assertions above
+        # still carry the rehearsal.
+        assert rep["rss_delta"] < 1.5 * rep["pbytes"], (
+            f"streamed 70B-shaped load grew RSS {rep['rss_delta']/1e9:.2f} GB"
+            f" for {rep['pbytes']/1e9:.2f} GB of params"
+        )

@@ -11,12 +11,12 @@ from fei_tpu.parallel.mesh import make_mesh
 from fei_tpu.parallel.ring import ring_attention, ulysses_attention
 
 
-def _oracle(q, k, v):
+def _oracle(q, k, v, window=0):
     """Plain causal self-attention (q_start=0, kv_length=T)."""
     B, T = q.shape[0], q.shape[1]
     positions = jnp.tile(jnp.arange(T)[None, :], (B, 1))
     kv_len = jnp.full((B,), T, dtype=jnp.int32)
-    return attention(q, k, v, positions, kv_len)
+    return attention(q, k, v, positions, kv_len, window=window)
 
 
 def _qkv(key, B, T, H, K, D):
@@ -51,6 +51,20 @@ class TestRingAttention:
         got = ring_attention(q, k, v, sp_mesh)
         np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-3)
 
+    def test_sliding_window_matches_oracle(self, sp_mesh):
+        """Window smaller than one shard's chunk: most ring steps visit
+        chunks that are entirely dead for most rows — full-causal CANNOT
+        pass this (VERDICT r3 #5: SWA × sp composition)."""
+        n = sp_mesh.shape["sp"]
+        B, T, H, K, D = 2, 16 * n, 4, 2, 32
+        q, k, v = _qkv(jax.random.PRNGKey(5), B, T, H, K, D)
+        for window in (8, 24):  # intra-chunk and chunk-straddling windows
+            want = _oracle(q, k, v, window=window)
+            got = ring_attention(q, k, v, sp_mesh, window=window)
+            np.testing.assert_allclose(
+                np.asarray(got), np.asarray(want), atol=2e-3
+            )
+
     def test_jit_compiles(self, sp_mesh):
         n = sp_mesh.shape["sp"]
         B, T, H, K, D = 1, 4 * n, 2, 2, 16
@@ -73,6 +87,16 @@ class TestUlysses:
         q, k, v = _qkv(jax.random.PRNGKey(3), B, T, H, K, D)
         want = _oracle(q, k, v)
         got = ulysses_attention(q, k, v, sp_mesh)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-3)
+
+    def test_sliding_window_matches_oracle(self, sp_mesh):
+        n = sp_mesh.shape["sp"]
+        B, T, D = 2, 4 * n, 32
+        H = K = n
+        q, k, v = _qkv(jax.random.PRNGKey(6), B, T, H, K, D)
+        window = max(2, T // 4)  # bites hard at this length
+        want = _oracle(q, k, v, window=window)
+        got = ulysses_attention(q, k, v, sp_mesh, window=window)
         np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-3)
 
     def test_rejects_indivisible_heads(self, sp_mesh):
