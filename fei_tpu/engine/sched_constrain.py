@@ -1,0 +1,97 @@
+"""Constraint policy of the paged scheduler (engine/scheduler.py).
+
+Grammar-constrained decode bookkeeping: installing the single device-native
+grammar (one [S, V] table pair serves every constrained request; a second
+distinct grammar falls back to host masks), the host DFA mirror that walks
+sampled tokens, and the host-mask evaluation used by logit_mask_fn requests
+and the fallback path. Split out of the scheduler class body (round-4) as a
+MIXIN over PagedScheduler state — see sched_admission.py for the rationale.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from fei_tpu.utils.metrics import METRICS
+
+
+class ConstraintMixin:
+    """Grammar install, host DFA mirror, and host-mask evaluation."""
+
+    def _set_grammar(self, grammar, prebuilt=None) -> bool:
+        """Install ``grammar`` as the device-native one. Returns False when
+        a DIFFERENT grammar still has in-flight requests (caller must fall
+        back to host masks). Called under self._lock; ``prebuilt`` device
+        tables come from the caller so the upload happens outside it."""
+        if self._ggrammar is grammar:
+            return True
+        inflight = any(
+            s is not None and s.grammar is not None for s in self._slots
+        ) or any(s.grammar is not None for s in self._waiting)
+        if self._ggrammar is not None and inflight:
+            return False
+        if prebuilt is None:
+            prebuilt = grammar.device_tables(self.engine.cfg.vocab_size)
+        self._gtable, self._gmind = prebuilt
+        self._ggrammar = grammar
+        return True
+
+
+    def _grammar_advance(self, seq: _Seq, t: int) -> tuple[bool, bool]:
+        """Advance the host DFA mirror with sampled token ``t``.
+        Returns (emit_token, finish_now). The device step applied the same
+        table, so the mirror walk can only land where the mask allowed."""
+        from fei_tpu.engine.grammar import char_walk
+
+        g = seq.grammar
+        if seq.gstate < 0:
+            # free phase: watch the streamed text for the trigger
+            suffix = seq.gscanner.feed(t)
+            if suffix is not None:
+                s = char_walk(g, suffix)
+                if s == g.accept:  # whole call inside the trigger token
+                    seq.gaccepted = True
+                    return True, True
+                if s >= 0:
+                    seq.gstate = s
+                else:
+                    METRICS.incr("scheduler.grammar_trigger_suffix_rejected")
+            return True, False
+        nxt = int(g.table[seq.gstate, t])
+        if nxt < 0:
+            METRICS.incr("scheduler.grammar_walked_off")
+            return True, False  # unreachable under the device mask
+        seq.gstate = nxt
+        if nxt == g.accept and seq.gtrigger is not None:
+            # tool-call protocol: the turn ends at acceptance. A stop
+            # token's accept edge is not part of the call text.
+            seq.gaccepted = True
+            return t not in seq.stops and t not in set(
+                self.engine.tokenizer.stop_token_ids
+            ), True
+        return True, False
+
+
+    def _grammar_first_mask(self, seq: _Seq) -> np.ndarray:
+        """Entry-state mask (with the dense path's budget-feasibility rule)
+        for a device-grammar request's first sampled token."""
+        from fei_tpu.engine.engine import pad_vocab_mask
+        from fei_tpu.engine.grammar import feasible_mask
+
+        g = seq.grammar
+        m = feasible_mask(g.table[seq.gstate], g.min_dist, seq.budget)
+        return pad_vocab_mask(m, self.engine.cfg.vocab_size, xp=np)
+
+
+    def _host_mask(self, seq: _Seq, first: bool = False) -> np.ndarray | None:
+        if seq.mask_fn is None:
+            return None
+        m = seq.mask_fn([] if first else seq.generated)
+        if m is None:
+            return None
+        from fei_tpu.engine.engine import pad_vocab_mask
+
+        return pad_vocab_mask(
+            np.asarray(m, dtype=bool), self.engine.cfg.vocab_size, xp=np
+        )
+

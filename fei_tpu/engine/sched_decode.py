@@ -1,0 +1,348 @@
+"""Decode-step half of the paged scheduler (engine/scheduler.py).
+
+The batched decode dispatches over armed slots: the single scanned step
+program shared by every path (host-masked single step, device-grammar
+constrained step, and the multi-step turbo scan that batches N steps into
+one dispatch), plus prompt-lookup speculation for the single-stream case.
+Split out of the scheduler class body (round-4) as a MIXIN over
+PagedScheduler state — see sched_admission.py for the rationale.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fei_tpu.engine.sampling import sample_logits_dynamic
+from fei_tpu.models.llama import forward_paged
+from fei_tpu.utils.logging import get_logger
+from fei_tpu.utils.metrics import METRICS
+
+log = get_logger("scheduler")
+
+
+class DecodeMixin:
+    """Batched decode stepping: spec, single, and multi-step dispatches."""
+
+    def _maybe_spec_step(self) -> bool:
+        """Prompt-lookup speculation inside the scheduler: when exactly one
+        greedy, unconstrained stream is decoding (the dominant agent-loop
+        serving shape), a repeated n-gram proposes draft tokens and ONE
+        multi-token paged dispatch (forward_paged_block) verifies them —
+        token-identical to the per-step path by construction, with up to
+        1 + draft_len tokens landing per weight read. Multi-stream batches
+        keep per-token steps (their throughput already amortizes the
+        weight read across slots). Returns True if a spec step ran."""
+        if not self.speculate:
+            return False
+        if self._admitting is not None:
+            return False
+        active = [
+            (b, s) for b, s in enumerate(self._slots) if s is not None
+        ]
+        if len(active) != 1:
+            return False
+        b, s = active[0]
+        if (
+            s.prefilling
+            or s.gen.temperature != 0.0
+            or s.mask_fn is not None
+            # device-grammar requests speculate during their FREE phase
+            # (pre-trigger — the bulk of an agent turn); once the DFA
+            # engages (gstate >= 0) verification can't apply the mask,
+            # so constrained decode keeps per-token steps
+            or (s.grammar is not None and s.gstate >= 0)
+        ):
+            return False
+        eng = self.engine
+        draft = eng._find_draft(
+            s.prompt_ids + s.generated, self.spec_ngram, self.spec_draft_len
+        )
+        if draft is None:
+            return False
+        T = 1 + self.spec_draft_len
+        # pool length for the slot: prompt + generated, minus the pending
+        # next_input whose KV is written when it is fed
+        L0 = len(s.prompt_ids) + len(s.generated) - 1
+        # room is ABSOLUTE top-end capacity: rolling-buffer SWA releases
+        # drop leading pages from pages_for, but the slot's reserved high
+        # positions are unchanged — count the released pages back in or
+        # long SWA streams silently lose speculation mid-stream
+        room = (
+            s.released_pages + len(eng._allocator.pages_for(b))
+        ) * eng.page_size
+        if L0 + T > min(room, eng.max_seq_len):
+            return False
+        draft = draft + [0] * (self.spec_draft_len - len(draft))
+        tokens = np.zeros((self.B, T), dtype=np.int32)
+        tokens[b] = [s.next_input] + draft
+        try:
+            with METRICS.span("spec_step"):
+                greedy_dev, self._pool = self._spec_fn(T)(
+                    eng.params, self._pool, jnp.asarray(tokens)
+                )
+                greedy = np.asarray(greedy_dev)[b]  # host sync in the span
+        except Exception as exc:  # noqa: BLE001
+            if self._pool_intact():
+                # compile-stage failure (e.g. Mosaic rejecting the block
+                # kernel on-chip): the donated pool was never consumed —
+                # drop to per-token steps instead of killing every stream
+                log.warning(
+                    "speculative step failed (%r); disabling speculation",
+                    exc,
+                )
+                self.speculate = False
+                METRICS.incr("scheduler.spec_disabled")
+                return False
+            raise  # pool consumed mid-execution: let _fail_all handle it
+        accept = 0
+        while (
+            accept < self.spec_draft_len
+            and draft[accept] == int(greedy[accept])
+        ):
+            accept += 1
+        # greedy[:accept + 1] are all model-chosen tokens (verified draft
+        # prefix + the bonus token)
+        METRICS.incr("scheduler.spec_steps")
+        METRICS.incr("scheduler.spec_accepted", accept)
+        delivered = 0
+        for t in [int(g) for g in greedy[: accept + 1]]:
+            self._deliver(s, t)
+            if s.finished:
+                break
+            delivered += 1
+            if s.grammar is not None and s.gstate >= 0:
+                # the tool-call trigger completed inside this block: the
+                # remaining verified tokens were sampled UNCONSTRAINED —
+                # drop them; the constrained phase re-decodes under the
+                # DFA mask from here
+                break
+        if not s.finished:
+            # KV is real through L0 + delivered - 1; the next fed token is
+            # s.next_input at position L0 + delivered. The block wrote T
+            # rows, so shrink the slot's length — inactive slots' lengths
+            # return to 0 (their writes landed in the null page)
+            lengths = np.zeros((self.B,), dtype=np.int32)
+            lengths[b] = L0 + delivered
+            self._pool = self._pool._replace(lengths=jnp.asarray(lengths))
+        return True
+
+
+    def _spec_fn(self, T: int):
+        key = ("spec", T)
+        if key not in self._step_jit:
+            cfg = self.engine.cfg
+            mesh = self.engine.mesh
+
+            def spec(params, pool, tokens):
+                from fei_tpu.models.llama import forward_paged_block
+
+                logits, pool = forward_paged_block(
+                    params, cfg, tokens, pool, kernel_mesh=mesh
+                )
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32), pool
+
+            self._step_jit[key] = jax.jit(spec, donate_argnums=(1,))
+        return self._step_jit[key]
+
+
+    def _step_active(self) -> None:
+        eng = self.engine
+        B, V = self.B, eng.cfg.vocab_size
+        if self._maybe_spec_step():
+            return
+        if self._try_multi_step():
+            return
+        # evaluate per-request masks FIRST: a user mask_fn that raises (or
+        # returns an over-wide mask) must kill only its own request, never
+        # the other in-flight sequences or the pool
+        masks: dict[int, np.ndarray] = {}
+        for b, s in list(enumerate(self._slots)):
+            if s is None or s.prefilling or s.mask_fn is None:
+                continue
+            try:
+                m = self._host_mask(s)
+            except BaseException as exc:  # noqa: BLE001
+                s.out.put(exc)
+                self._finish(s)
+                continue
+            if m is not None:
+                masks[b] = m
+        # decode only runs for armed slots; chunk-prefilling slots write to
+        # the null page (their table row is still zeroed) and are skipped
+        active = [
+            (b, s) for b, s in enumerate(self._slots)
+            if s is not None and not s.prefilling
+        ]
+        if not active:
+            return
+
+        masked = bool(masks)
+        mask = None
+        if masked:
+            mask = np.ones((B, V), dtype=bool)
+            for b, m in masks.items():
+                mask[b] = m
+            # every host-evaluated mask pays a [B, V] upload — the metric
+            # the device-native grammar path is measured against
+            METRICS.incr("scheduler.host_mask_uploads", len(masks))
+        toks = self._dispatch_steps(active, 1, mask=mask)
+        for b, s in active:
+            # defensive symmetry with the multi-step loop; with n=1 nothing
+            # can replace a slot between assembly and delivery
+            if self._slots[b] is not s:
+                continue
+            self._deliver(s, int(toks[b, 0]))
+
+
+    def _try_multi_step(self) -> bool:
+        """Run up to ``self.multistep`` decode steps in ONE device dispatch.
+
+        Eligible only when the host has nothing to do between steps: no
+        queued or in-flight admission, every armed slot maskless and not
+        in a grammar free phase (the trigger scanner must see each token
+        as it streams), and every slot has >= N budget left — so tokens
+        decoded past a mid-scan stop stay inside the slot's reserved
+        pages (they are never delivered, and prefix-cache registration
+        only covers delivered tokens, so garbage positions are
+        unreachable). Constrained slots are fine: the scan advances their
+        DFA states on device exactly like the dense fused path."""
+        cap = self.multistep
+        if cap <= 1 or self._waiting or self._admitting is not None:
+            return False
+        active = [(b, s) for b, s in enumerate(self._slots) if s is not None]
+        if not active:
+            return False
+        for _, s in active:
+            if s.prefilling or s.mask_fn is not None:
+                return False
+            if s.grammar is not None and s.gstate < 0:
+                return False
+        headroom = min(s.budget - len(s.generated) for _, s in active)
+        n = 1
+        while n * 2 <= min(cap, headroom):
+            n *= 2
+        if n <= 1:
+            return False
+
+        toks = self._dispatch_steps(active, n)
+        METRICS.incr("scheduler.multi_steps")
+        METRICS.incr("scheduler.multi_tokens", n)
+        for i in range(n):
+            for b, s in active:
+                if self._slots[b] is not s:  # finished at an earlier step
+                    continue
+                self._deliver(s, int(toks[b, i]))
+        return True
+
+
+    def _dispatch_steps(
+        self, active, n: int, mask: np.ndarray | None = None
+    ) -> np.ndarray:
+        """Assemble the [B] batch vectors from ``active`` slots and run
+        ``n`` scanned decode steps in one compiled dispatch; returns the
+        sampled tokens [B, n] (ONE host sync for the whole scan). A host
+        ``mask`` ([B, V] bool) only composes with n == 1 — host masks must
+        be re-evaluated between steps."""
+        eng = self.engine
+        B = self.B
+        tokens = np.zeros((B, 1), dtype=np.int32)
+        temps = np.zeros((B,), dtype=np.float32)
+        topks = np.zeros((B,), dtype=np.int32)
+        topps = np.ones((B,), dtype=np.float32)
+        minps = np.zeros((B,), dtype=np.float32)
+        gstates = np.full((B,), -1, dtype=np.int32)
+        gremain = np.zeros((B,), dtype=np.int32)
+        grammared = False
+        for b, s in active:
+            tokens[b, 0] = s.next_input
+            temps[b] = s.gen.temperature
+            topks[b] = s.gen.top_k
+            topps[b] = s.gen.top_p
+            minps[b] = s.gen.min_p
+            if s.grammar is not None and s.gstate >= 0:
+                # the [B] state/budget vectors ride the same upload as the
+                # token ids; the [S, V] table never leaves the device
+                gstates[b] = s.gstate
+                gremain[b] = s.budget - len(s.generated)
+                grammared = True
+        step = self._multi_fn(n, grammared, masked=mask is not None)
+        args = [eng.params, self._pool, jnp.asarray(tokens), self._keys,
+                jnp.asarray(temps), jnp.asarray(topks), jnp.asarray(topps),
+                jnp.asarray(minps)]
+        kw = {}
+        if grammared:
+            kw.update(
+                gstates=jnp.asarray(gstates), gremain=jnp.asarray(gremain),
+                table=self._gtable, mind=self._gmind,
+            )
+        if mask is not None:
+            kw["mask"] = jnp.asarray(mask)
+        with METRICS.span("decode_step"):
+            nxt, self._pool, self._keys = step(*args, **kw)
+            return np.asarray(nxt)  # host sync inside the span
+
+
+    def _multi_fn(self, n_steps: int, grammared: bool, masked: bool = False):
+        """The scanned decode-step program: every scheduler decode — the
+        single step (n=1, optionally host-masked) and the multi-step turbo
+        scan — shares this one body, so grammar/sampling semantics cannot
+        drift between paths."""
+        key = ("multi", n_steps, grammared, masked)
+        if key not in self._step_jit:
+            cfg = self.engine.cfg
+            mesh = self.engine.mesh  # tp mesh: kernel runs via shard_map
+
+            def multi(params, pool, tokens, keys, temps, topks, topps,
+                      minps, gstates=None, gremain=None, table=None,
+                      mind=None, mask=None):
+                from fei_tpu.engine.grammar import feasible_mask
+
+                def body(carry, _):
+                    if grammared:
+                        pool, tokens, keys, gstates, gremain = carry
+                    else:
+                        pool, tokens, keys = carry
+                    logits, pool = forward_paged(
+                        params, cfg, tokens, pool, kernel_mesh=mesh
+                    )
+                    logits = logits[:, -1, :]
+                    if grammared:
+                        # per-slot DFA mask, entirely on device: slots with
+                        # gstate < 0 (free/unconstrained) pass through.
+                        # Budget feasibility is the shared rule
+                        # (grammar.feasible_mask, same as the dense scan).
+                        use = gstates >= 0
+                        srow = table[jnp.maximum(gstates, 0)]  # [B, V]
+                        gmask = feasible_mask(srow, mind, gremain, xp=jnp)
+                        gmask = jnp.where(use[:, None], gmask, True)
+                        logits = jnp.where(gmask, logits, -jnp.inf)
+                    if masked:
+                        logits = jnp.where(mask, logits, -jnp.inf)
+                    outs = jax.vmap(jax.random.split)(keys)  # [B, 2, 2]
+                    new_keys, subs = outs[:, 0], outs[:, 1]
+                    nxt = sample_logits_dynamic(
+                        logits, subs, temps, topks, topps, minps
+                    )
+                    if grammared:
+                        nstate = jnp.take_along_axis(
+                            srow, nxt[:, None], axis=1
+                        )[:, 0].astype(jnp.int32)
+                        gstates = jnp.where(use, nstate, gstates)
+                        gremain = jnp.where(use, gremain - 1, gremain)
+                        carry = (pool, nxt[:, None], new_keys, gstates, gremain)
+                    else:
+                        carry = (pool, nxt[:, None], new_keys)
+                    return carry, nxt
+
+                init = (
+                    (pool, tokens, keys, gstates, gremain) if grammared
+                    else (pool, tokens, keys)
+                )
+                carry, toks = jax.lax.scan(body, init, None, length=n_steps)
+                return jnp.swapaxes(toks, 0, 1), carry[0], carry[2]
+
+            self._step_jit[key] = jax.jit(multi, donate_argnums=(1,))
+        return self._step_jit[key]
+

@@ -45,8 +45,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from fei_tpu.engine.sampling import sample_logits, sample_logits_dynamic
-from fei_tpu.models.llama import KVCache, forward, forward_paged
+from fei_tpu.engine.sched_admission import AdmissionMixin
+from fei_tpu.engine.sched_constrain import ConstraintMixin
+from fei_tpu.engine.sched_decode import DecodeMixin
 from fei_tpu.utils.errors import EngineError
 from fei_tpu.utils.logging import get_logger
 from fei_tpu.utils.metrics import METRICS
@@ -93,12 +94,22 @@ class _Seq:
     released_pages: int = 0
 
 
-class PagedScheduler:
+class PagedScheduler(AdmissionMixin, DecodeMixin, ConstraintMixin):
     """Multi-sequence decode over one paged pool (one per paged engine).
 
     ``engine.batch_size`` bounds concurrent sequences; further requests
     queue FIFO and admit as slots free up. A request whose page demand can
     never fit the pool fails immediately with EngineError.
+
+    The class body here holds the request lifecycle (submit/stream/cancel,
+    the device-loop thread, token delivery, eviction, failure handling)
+    and the shared state every path mutates; the three feature surfaces
+    live in sibling modules as mixins over this state (round-4 split):
+    sched_admission.AdmissionMixin (queue -> armed slot), sched_decode.
+    DecodeMixin (batched/multi-step/speculative stepping), and
+    sched_constrain.ConstraintMixin (grammar install + host DFA mirror +
+    host masks). Mixins, not delegate objects: the interleaving invariants
+    (single owner thread, lock discipline, donated pool) stay one-object.
     """
 
     def __init__(self, engine):
@@ -294,24 +305,6 @@ class PagedScheduler:
         self._wake.set()
         return seq
 
-    def _set_grammar(self, grammar, prebuilt=None) -> bool:
-        """Install ``grammar`` as the device-native one. Returns False when
-        a DIFFERENT grammar still has in-flight requests (caller must fall
-        back to host masks). Called under self._lock; ``prebuilt`` device
-        tables come from the caller so the upload happens outside it."""
-        if self._ggrammar is grammar:
-            return True
-        inflight = any(
-            s is not None and s.grammar is not None for s in self._slots
-        ) or any(s.grammar is not None for s in self._waiting)
-        if self._ggrammar is not None and inflight:
-            return False
-        if prebuilt is None:
-            prebuilt = grammar.device_tables(self.engine.cfg.vocab_size)
-        self._gtable, self._gmind = prebuilt
-        self._ggrammar = grammar
-        return True
-
     def cancel(self, seq: _Seq) -> None:
         with self._lock:
             if seq in self._waiting:
@@ -397,205 +390,6 @@ class PagedScheduler:
             if s is not None and s.cancelled and not s.finished:
                 self._finish(s)
 
-    def _admit_ready(self) -> None:
-        """FIFO admission: fill free slots while the pool has pages. Head-of-
-        line blocking is deliberate — it guarantees a too-big-for-now request
-        eventually runs instead of starving behind smaller latecomers.
-
-        A chunked admission in flight gets exactly one chunk of prefill per
-        call, so the caller's loop interleaves it with decode steps."""
-        if self._admitting is not None:
-            seq, slot = self._admitting["seq"], self._admitting["slot"]
-            try:
-                self._admit_chunk()
-            except BaseException as exc:  # noqa: BLE001
-                self._admitting = None
-                self.engine._allocator.free(slot)
-                self._slots[slot] = None
-                seq.finished = True
-                seq.out.put(exc)
-            return
-        while True:
-            with self._lock:
-                if not self._waiting:
-                    return
-                free = [b for b, s in enumerate(self._slots) if s is None]
-                if not free:
-                    return
-                seq = self._waiting[0]
-                alloc = self.engine._allocator
-                if seq.prefix_match is None:
-                    seq.prefix_match = (
-                        self._prefix.match(seq.prompt_ids) if self._prefix else []
-                    )
-                prefix = seq.prefix_match
-                if prefix:
-                    # pin the matched pages: LRU eviction below must never
-                    # free the entry this admission is about to reuse.
-                    # Defensive: memoized matches are re-probed whenever the
-                    # pin is dropped (below), so a stale match should be
-                    # impossible — but recover by re-probing if one appears.
-                    try:
-                        alloc.take_ref(prefix)
-                    except EngineError:
-                        seq.prefix_match = prefix = self._prefix.match(
-                            seq.prompt_ids
-                        )
-                        if prefix:
-                            alloc.take_ref(prefix)
-                need = alloc.pages_needed(
-                    min(len(seq.prompt_ids) + seq.budget, self.engine.max_seq_len)
-                ) - len(prefix)
-                if need > alloc.free_pages and self._prefix is not None:
-                    # registry references are reclaimable capacity
-                    self._prefix.evict_for(need)
-                if need > alloc.free_pages:
-                    if prefix:
-                        alloc.drop_ref(prefix)
-                        # the pin is gone: a page of the memoized match can
-                        # be recycled before the retry, and take_ref's
-                        # refcount>0 probe cannot tell "same content" from
-                        # "page reused by another sequence" — force the
-                        # retry to re-probe the registry instead
-                        seq.prefix_match = None
-                    return
-                self._waiting.popleft()
-                slot = free[0]
-                self._slots[slot] = seq
-                seq.slot = slot
-                if prefix:
-                    alloc.share(slot, prefix)
-                    alloc.drop_ref(prefix)  # pin handed over to the seq ref
-            try:
-                # long prompts on an sp mesh admit SEQUENCE-SHARDED in one
-                # dispatch (ring-attention full-model prefill via
-                # engine.prefill's routing) — n× fewer dispatches than
-                # serial chunks. The single dispatch DOES stall live decode
-                # for its duration, so it is capped: beyond
-                # sp_admit_factor × prefill_chunk tokens PER DEVICE the
-                # chunked path keeps its bounded-stall guarantee. Prefix-
-                # cache hits also keep the chunked path: its page gather
-                # already skips recomputing the cached tokens.
-                n_tok = len(seq.prompt_ids)
-                sp_n = (
-                    self.engine.mesh.shape.get("sp", 1)
-                    if self.engine.mesh is not None else 1
-                )
-                sp_long = (
-                    not prefix
-                    and self.engine._sp_prefill_eligible(n_tok)
-                    and n_tok <= self.sp_admit_factor * self.prefill_chunk * sp_n
-                )
-                if (
-                    prefix or len(seq.prompt_ids) > self.prefill_chunk
-                ) and not sp_long:
-                    if self.paged_native_prefill:
-                        self._start_chunked_paged(seq, slot, prefix)
-                    else:
-                        self._start_chunked(seq, slot, prefix)
-                    return  # one chunked admission at a time
-                self._admit(seq, slot)
-            except BaseException as exc:  # noqa: BLE001
-                self._admitting = None
-                self.engine._allocator.free(slot)
-                self._slots[slot] = None
-                seq.finished = True
-                seq.out.put(exc)
-
-    def _admit(self, seq: _Seq, slot: int) -> None:
-        eng = self.engine
-        cfg = eng.cfg
-        alloc = eng._allocator
-        prompt = seq.prompt_ids
-        n = len(prompt)
-        need = alloc.pages_needed(min(n + seq.budget, eng.max_seq_len))
-        alloc.alloc(slot, need)
-
-        with METRICS.span("prefill", jax_trace=True):
-            from fei_tpu.engine.engine import _next_bucket
-
-            bucket = min(_next_bucket(n), eng.max_seq_len)
-            dense = KVCache.create(cfg, 1, bucket, dtype=eng.dtype)
-            last_logits, dense = eng.prefill([prompt], dense)
-            last_logits.block_until_ready()
-
-        self._complete_admission(seq, slot, dense, bucket, last_logits)
-
-    def _start_chunked(
-        self, seq: _Seq, slot: int, prefix: list[int] | None = None
-    ) -> None:
-        """Begin a chunked admission: pages reserved up front, prompt K/V
-        built chunk-by-chunk across loop iterations so concurrent decode
-        streams stall at most one chunk's prefill at a time. A cached
-        prefix (``prefix`` pages, already shared to the slot) gathers into
-        the dense staging cache and only the suffix prefills."""
-        eng = self.engine
-        alloc = eng._allocator
-        prefix = prefix or []
-        m = self._reserve_admission(seq, slot, prefix)
-        ps = alloc.page_size
-        n = len(seq.prompt_ids)
-        from fei_tpu.engine.engine import _next_bucket
-
-        # the bucket MUST fit every full chunk write: chunks write C-row
-        # slices starting at m*ps, and a final chunk extending past the
-        # cache would be silently clamped by dynamic_update_slice —
-        # corrupting earlier K/V positions instead of erroring
-        C = self.prefill_chunk
-        start = m * ps
-        # gather width pads to a power of two so the compile cache stays
-        # log-bounded in prefix length; pad slots read the null page and
-        # anything past m*ps is masked by the cache length (and overwritten
-        # by the suffix chunks where they reach)
-        gm = 1
-        while gm < max(m, 1):
-            gm *= 2
-        # cap the power-of-two pad target at max_seq_len BEFORE the
-        # ceil-to-chunk: a near-max_seq_len prompt must not stage a cache
-        # ~2x larger than the engine will ever read. The ceil-to-chunk then
-        # keeps bucket >= start + ceil((n-start)/C)*C — every chunk write
-        # fits, so dynamic_update_slice never clamps (n <= max_seq_len)
-        target = min(_next_bucket(n), eng.max_seq_len)
-        bucket = start + -(-max(target - start, C) // C) * C
-        # …and round to a page multiple: the dense→paged scatter at
-        # completion slices [start, ceil(n/ps)*ps) and its slice start
-        # would clamp (misaligning every suffix page) if the capped,
-        # C-granular bucket fell below that page-aligned extent
-        bucket = -(-bucket // ps) * ps
-        # the padded gather writes gm*ps rows at offset 0; the bucket must
-        # hold them or dynamic_update_slice would clamp and corrupt
-        bucket = max(bucket, gm * ps if m else 0)
-        dense = KVCache.create(eng.cfg, 1, bucket, dtype=eng.dtype)
-        if m:
-            padded = prefix + [0] * (gm - m)
-            gather = self._gather_fn(gm, bucket)
-            dense = gather(
-                self._pool, jnp.asarray(padded, dtype=jnp.int32), dense,
-                jnp.int32(m * ps),
-            )
-        self._admitting = {
-            "seq": seq, "slot": slot, "dense": dense,
-            "pos": start, "bucket": bucket, "prefix": m,
-        }
-        self._admit_chunk()
-
-    def _reserve_admission(
-        self, seq: _Seq, slot: int, prefix: list[int]
-    ) -> int:
-        """Shared admission prologue: reserve the slot's fresh pages
-        (shared prefix pages were already handed over) and mark it
-        prefilling. Returns the prefix page count. One implementation so
-        the staging and paged-native paths can never diverge on the page
-        budget."""
-        eng = self.engine
-        alloc = eng._allocator
-        m = len(prefix)
-        n = len(seq.prompt_ids)
-        need = alloc.pages_needed(min(n + seq.budget, eng.max_seq_len))
-        alloc.alloc(slot, need - m)
-        seq.prefilling = True
-        return m
-
     def _slot_row(self, slot: int) -> np.ndarray:
         """The slot's padded block-table row (null-page padded)."""
         from fei_tpu.engine.paged_cache import build_block_table
@@ -603,351 +397,6 @@ class PagedScheduler:
         width = self._pool.block_table.shape[1]
         pages = self.engine._allocator.pages_for(slot)
         return np.asarray(build_block_table([pages], width))[0]
-
-    def _start_chunked_paged(
-        self, seq: _Seq, slot: int, prefix: list[int] | None = None
-    ) -> None:
-        """Paged-NATIVE chunked admission: each chunk forwards against a
-        one-slot view of the pool (its block-table row + running length),
-        writing K/V straight into the slot's pages and attending through
-        the multi-query block kernel — pool history INCLUDING any shared
-        prefix pages is read in place. No dense staging cache, no
-        completion scatter, no prefix gather. The slot's row in the live
-        pool stays ZERO until completion, so interleaved decode steps keep
-        writing this slot's idle token to the null page."""
-        prefix = prefix or []
-        m = self._reserve_admission(seq, slot, prefix)
-        self._admitting = {
-            "seq": seq, "slot": slot, "mode": "paged",
-            "row": self._slot_row(slot),
-            "pos": m * self.engine.page_size, "prefix": m,
-        }
-        self._admit_chunk()
-
-    def _admit_chunk(self) -> None:
-        """Run ONE prefill chunk of the in-flight chunked admission."""
-        st = self._admitting
-        seq = st["seq"]
-        if seq.finished:  # reaped by _reap_cancelled already
-            self._admitting = None
-            return
-        if seq.cancelled:
-            self._admitting = None
-            self._finish(seq)
-            return
-        eng = self.engine
-        C = self.prefill_chunk
-        prompt = seq.prompt_ids
-        n, lo = len(prompt), st["pos"]
-        hi = min(lo + C, n)
-        toks = np.zeros((1, C), dtype=np.int32)
-        toks[0, : hi - lo] = prompt[lo:hi]
-        final = hi >= n
-        if st.get("mode") == "paged":
-            try:
-                with METRICS.span("prefill_chunk", jax_trace=True):
-                    fn = self._paged_chunk_fn(C, final)
-                    out = fn(
-                        eng.params, self._pool, jnp.asarray(toks),
-                        jnp.asarray(st["row"][None]),
-                        jnp.asarray([lo], dtype=jnp.int32),
-                        jnp.int32(n - 1 - lo),
-                    )
-                    if final:
-                        last_logits, self._pool = out
-                        last_logits.block_until_ready()
-                    else:
-                        self._pool = out
-            except Exception as exc:  # noqa: BLE001
-                first = lo == st["prefix"] * eng.page_size
-                if first and self._pool_intact():
-                    # first chunk, pool untouched (e.g. Mosaic rejected the
-                    # chunk tile on-chip): release the slot and requeue the
-                    # request at the FRONT — it re-admits through the
-                    # normal path with the native route disabled, shared
-                    # prefix pages surviving on their registry refs
-                    log.warning(
-                        "paged-native prefill failed (%r); falling back to "
-                        "the dense-staging path", exc,
-                    )
-                    self.paged_native_prefill = False
-                    METRICS.incr("scheduler.paged_prefill_disabled")
-                    self._admitting = None
-                    eng._allocator.free(st["slot"])
-                    self._slots[st["slot"]] = None
-                    seq.slot = -1
-                    seq.prefilling = False
-                    seq.prefix_match = None  # pins dropped: re-probe
-                    with self._lock:
-                        self._waiting.appendleft(seq)
-                    return
-                raise
-            st["pos"] = hi
-            if not final:
-                return  # more chunks; decode steps interleave
-            self._admitting = None
-            self._complete_admission_paged(
-                seq, st["slot"], last_logits, st["row"]
-            )
-            return
-        with METRICS.span("prefill_chunk", jax_trace=True):
-            fn = self._chunk_fn(C, st["bucket"])
-            last_logits, st["dense"] = fn(
-                eng.params, st["dense"], jnp.asarray(toks), jnp.int32(hi - lo)
-            )
-            last_logits.block_until_ready()
-        st["pos"] = hi
-        if hi < n:
-            return  # more chunks; decode steps interleave
-        self._admitting = None
-        self._complete_admission(
-            seq, st["slot"], st["dense"], st["bucket"], last_logits,
-            prefix_pages=st.get("prefix", 0),
-        )
-
-    def _paged_chunk_fn(self, C: int, final: bool):
-        """Compiled paged-native prefill chunk: forward [1, C] tokens
-        against a one-slot pool view (block-table row + absolute position
-        as the length), K/V landing in the slot's pages via the block
-        kernel's per-row causal writes. Pad tokens in a final partial
-        chunk write into the slot's not-yet-decoded future pages (later
-        overwritten position-by-position by decode) or — past the table's
-        capacity — into the reserved null page (write_token_kv routes
-        out-of-range positions there); either way they are never attended
-        (causal limits). Only the final chunk projects one position
-        through the LM head."""
-        key = (C, final)
-        if key not in self._pchunk_jit:
-            cfg = self.engine.cfg
-            mesh = self.engine.mesh
-            from fei_tpu.models.llama import _logits, forward_paged_block
-
-            def chunk(params, pool, toks, row, pos, last_idx):
-                view = pool._replace(block_table=row, lengths=pos)
-                hidden, view = forward_paged_block(
-                    params, cfg, toks, view, kernel_mesh=mesh, lm_head=False
-                )
-                # hand the updated pages back under the LIVE table/lengths:
-                # decode must keep seeing the zeroed row until completion
-                out_pool = view._replace(
-                    block_table=pool.block_table, lengths=pool.lengths
-                )
-                if not final:
-                    return out_pool
-                h_last = jax.lax.dynamic_slice_in_dim(
-                    hidden, last_idx, 1, axis=1
-                )  # [1, 1, H] — already final-normed (lm_head=False contract)
-                return _logits(h_last, params, cfg, kernel_mesh=mesh)[:, 0], out_pool
-
-            self._pchunk_jit[key] = jax.jit(chunk, donate_argnums=(1,))
-        return self._pchunk_jit[key]
-
-    def _arm_fn(self):
-        """Compiled slot arming: install the block-table row and the true
-        prompt length so decode starts reading the admitted pages."""
-        if self._arm_jit is None:
-
-            def arm(pool, row, slot, length):
-                bt = jax.lax.dynamic_update_slice(
-                    pool.block_table, row[None], (slot, 0)
-                )
-                ln = jax.lax.dynamic_update_slice(
-                    pool.lengths, length[None], (slot,)
-                )
-                return pool._replace(block_table=bt, lengths=ln)
-
-            self._arm_jit = jax.jit(arm, donate_argnums=(0,))
-        return self._arm_jit
-
-    def _complete_admission_paged(
-        self, seq: _Seq, slot: int, last_logits, row: np.ndarray
-    ) -> None:
-        """Admission tail for the paged-native path: sample the first
-        token, arm the slot's table row + length, register the prefix.
-        ``row`` is the block-table row the chunks wrote through (pages
-        cannot change mid-admission)."""
-        eng = self.engine
-        alloc = eng._allocator
-        n = len(seq.prompt_ids)
-        tok0, rng = self._first_token(seq, last_logits)
-        pages = alloc.pages_for(slot)
-        self._pool = self._arm_fn()(
-            self._pool, jnp.asarray(row), jnp.int32(slot),
-            jnp.asarray(n, dtype=jnp.int32),
-        )
-        self._keys = self._keys.at[slot].set(rng)
-        seq.prefilling = False
-        if self._prefix is not None:
-            self._prefix.register(
-                seq.prompt_ids, pages[: alloc.pages_needed(n)]
-            )
-        if seq.budget <= 0:
-            self._finish(seq)
-            return
-        self._deliver(seq, tok0)
-
-    def _gather_fn(self, gm: int, bucket: int):
-        """Compiled prefix gather: ``gm`` (power-of-two padded) cached pages
-        -> the first gm*ps token positions of a dense staging cache
-        (dequantizing int8 pools), with the cache length set to the TRUE
-        prefix extent (traced). The suffix then prefills against it like
-        any grown cache; pad-page garbage past the true extent is masked by
-        the length and overwritten by the suffix chunks."""
-        key = (gm, bucket)
-        if key not in self._gather_jit:
-            ps = self.engine.page_size
-
-            def gather(pool, pages, dense, true_tokens):
-                # pool pages: [L, P, K, ps, D]; pages: [gm]
-                def pick(pool_pages, scales):
-                    g = pool_pages[:, pages]  # [L, gm, K, ps, D]
-                    if scales is not None:
-                        s = jnp.moveaxis(
-                            scales[:, pages], -1, -2
-                        )  # [L, gm, K, ps, 1]
-                        g = g.astype(jnp.float32) * s
-                    L, _, K, _, D = g.shape
-                    x = jnp.transpose(g, (0, 1, 3, 2, 4)).reshape(
-                        L, gm * ps, K, D
-                    )
-                    return x[:, None].astype(dense.k.dtype)  # [L, 1, gm*ps, K, D]
-
-                k = jax.lax.dynamic_update_slice(
-                    dense.k, pick(pool.k_pages, pool.k_scales), (0, 0, 0, 0, 0)
-                )
-                v = jax.lax.dynamic_update_slice(
-                    dense.v, pick(pool.v_pages, pool.v_scales), (0, 0, 0, 0, 0)
-                )
-                return dense._replace(
-                    k=k, v=v, length=true_tokens[None].astype(jnp.int32),
-                )
-
-            self._gather_jit[key] = jax.jit(gather, donate_argnums=(2,))
-        return self._gather_jit[key]
-
-    def _chunk_fn(self, C: int, bucket: int):
-        """Compiled one-chunk prefill against a persistent dense cache
-        (donated): forward over [1, C] tokens, cache length corrected to
-        the chunk's true token count (padding K/V beyond it is overwritten
-        by the next chunk and masked by attention). Only the chunk's last
-        valid position goes through the LM head — intermediate chunks never
-        pay the [C, V] logits matmul."""
-        key = (C, bucket)
-        if key not in self._chunk_jit:
-            cfg = self.engine.cfg
-            routed = self.engine.mesh is None
-            moe_mesh = self.engine._moe_mesh()
-            kernel_mesh = self.engine.mesh
-            from fei_tpu.models.llama import _logits
-
-            def chunk(params, dense, toks, true_len):
-                hidden, cache2 = forward(
-                    params, cfg, toks, dense,
-                    routed_moe=routed, moe_mesh=moe_mesh, lm_head=False,
-                    kernel_mesh=kernel_mesh,
-                )
-                cache2 = cache2._replace(length=dense.length + true_len)
-                h_last = jax.lax.dynamic_slice_in_dim(
-                    hidden, true_len - 1, 1, axis=1
-                )  # [1, 1, H]
-                return _logits(h_last, params, cfg, kernel_mesh=kernel_mesh)[
-                    :, 0
-                ], cache2
-
-            self._chunk_jit[key] = jax.jit(chunk, donate_argnums=(1,))
-        return self._chunk_jit[key]
-
-    def _first_token(self, seq: _Seq, last_logits) -> tuple[int, jax.Array]:
-        """Sample the admission's first token on the request's own key
-        chain (exactly like the dense single-stream prologue,
-        engine._prefill_sample), with the first-step host/grammar mask."""
-        mask = self._host_mask(seq, first=True)
-        if mask is None and seq.grammar is not None and seq.gstate >= 0:
-            # the first token samples from prefill logits outside the step
-            # program — one [V] mask per REQUEST at admission, not per step
-            mask = self._grammar_first_mask(seq)
-        if mask is not None:
-            last_logits = jnp.where(jnp.asarray(mask)[None, :], last_logits, -jnp.inf)
-        rng = jax.random.PRNGKey(seq.gen.seed)
-        rng, sub = jax.random.split(rng)
-        tok0 = int(
-            sample_logits(
-                last_logits, sub,
-                temperature=seq.gen.temperature,
-                top_k=seq.gen.top_k, top_p=seq.gen.top_p,
-                min_p=seq.gen.min_p,
-            )[0]
-        )
-        return tok0, rng
-
-    def _complete_admission(
-        self, seq: _Seq, slot: int, dense, bucket: int, last_logits,
-        prefix_pages: int = 0,
-    ) -> None:
-        """Admission tail for the dense-staging path: sample the first
-        token, scatter the NEW prompt K/V into pages (cached-prefix pages
-        already hold theirs and are never rewritten), arm the slot."""
-        eng = self.engine
-        alloc = eng._allocator
-        n = len(seq.prompt_ids)
-        tok0, rng = self._first_token(seq, last_logits)
-
-        # suffix K/V → pages + block-table row + length, pool donated
-        pages = alloc.pages_for(slot)  # prefix pages first, then fresh
-        n_prompt_pages = alloc.pages_needed(n)
-        write_pages = pages[prefix_pages:n_prompt_pages]
-        row = self._slot_row(slot)
-        start = prefix_pages * alloc.page_size
-        admit_fn = self._admit_fn(bucket, len(write_pages))
-        self._pool = admit_fn(
-            self._pool, dense.k, dense.v,
-            jnp.asarray(write_pages, dtype=jnp.int32),
-            jnp.asarray(row),
-            jnp.int32(slot), jnp.int32(n), jnp.int32(start),
-        )
-        self._keys = self._keys.at[slot].set(rng)
-        seq.prefilling = False
-        if self._prefix is not None:
-            self._prefix.register(seq.prompt_ids, pages[:n_prompt_pages])
-
-        if seq.budget <= 0:
-            self._finish(seq)
-            return
-        self._deliver(seq, tok0)
-
-    def _grammar_advance(self, seq: _Seq, t: int) -> tuple[bool, bool]:
-        """Advance the host DFA mirror with sampled token ``t``.
-        Returns (emit_token, finish_now). The device step applied the same
-        table, so the mirror walk can only land where the mask allowed."""
-        from fei_tpu.engine.grammar import char_walk
-
-        g = seq.grammar
-        if seq.gstate < 0:
-            # free phase: watch the streamed text for the trigger
-            suffix = seq.gscanner.feed(t)
-            if suffix is not None:
-                s = char_walk(g, suffix)
-                if s == g.accept:  # whole call inside the trigger token
-                    seq.gaccepted = True
-                    return True, True
-                if s >= 0:
-                    seq.gstate = s
-                else:
-                    METRICS.incr("scheduler.grammar_trigger_suffix_rejected")
-            return True, False
-        nxt = int(g.table[seq.gstate, t])
-        if nxt < 0:
-            METRICS.incr("scheduler.grammar_walked_off")
-            return True, False  # unreachable under the device mask
-        seq.gstate = nxt
-        if nxt == g.accept and seq.gtrigger is not None:
-            # tool-call protocol: the turn ends at acceptance. A stop
-            # token's accept edge is not part of the call text.
-            seq.gaccepted = True
-            return t not in seq.stops and t not in set(
-                self.engine.tokenizer.stop_token_ids
-            ), True
-        return True, False
 
     def _deliver(self, seq: _Seq, t: int) -> None:
         """Handle one sampled token for an armed sequence — grammar walk,
@@ -1000,322 +449,6 @@ class PagedScheduler:
             self.engine._allocator.release_prefix(seq.slot, n)
             seq.released_pages = releasable
             METRICS.incr("scheduler.swa_pages_released", n)
-
-    def _maybe_spec_step(self) -> bool:
-        """Prompt-lookup speculation inside the scheduler: when exactly one
-        greedy, unconstrained stream is decoding (the dominant agent-loop
-        serving shape), a repeated n-gram proposes draft tokens and ONE
-        multi-token paged dispatch (forward_paged_block) verifies them —
-        token-identical to the per-step path by construction, with up to
-        1 + draft_len tokens landing per weight read. Multi-stream batches
-        keep per-token steps (their throughput already amortizes the
-        weight read across slots). Returns True if a spec step ran."""
-        if not self.speculate:
-            return False
-        if self._admitting is not None:
-            return False
-        active = [
-            (b, s) for b, s in enumerate(self._slots) if s is not None
-        ]
-        if len(active) != 1:
-            return False
-        b, s = active[0]
-        if (
-            s.prefilling
-            or s.gen.temperature != 0.0
-            or s.mask_fn is not None
-            # device-grammar requests speculate during their FREE phase
-            # (pre-trigger — the bulk of an agent turn); once the DFA
-            # engages (gstate >= 0) verification can't apply the mask,
-            # so constrained decode keeps per-token steps
-            or (s.grammar is not None and s.gstate >= 0)
-        ):
-            return False
-        eng = self.engine
-        draft = eng._find_draft(
-            s.prompt_ids + s.generated, self.spec_ngram, self.spec_draft_len
-        )
-        if draft is None:
-            return False
-        T = 1 + self.spec_draft_len
-        # pool length for the slot: prompt + generated, minus the pending
-        # next_input whose KV is written when it is fed
-        L0 = len(s.prompt_ids) + len(s.generated) - 1
-        # room is ABSOLUTE top-end capacity: rolling-buffer SWA releases
-        # drop leading pages from pages_for, but the slot's reserved high
-        # positions are unchanged — count the released pages back in or
-        # long SWA streams silently lose speculation mid-stream
-        room = (
-            s.released_pages + len(eng._allocator.pages_for(b))
-        ) * eng.page_size
-        if L0 + T > min(room, eng.max_seq_len):
-            return False
-        draft = draft + [0] * (self.spec_draft_len - len(draft))
-        tokens = np.zeros((self.B, T), dtype=np.int32)
-        tokens[b] = [s.next_input] + draft
-        try:
-            with METRICS.span("spec_step"):
-                greedy_dev, self._pool = self._spec_fn(T)(
-                    eng.params, self._pool, jnp.asarray(tokens)
-                )
-                greedy = np.asarray(greedy_dev)[b]  # host sync in the span
-        except Exception as exc:  # noqa: BLE001
-            if self._pool_intact():
-                # compile-stage failure (e.g. Mosaic rejecting the block
-                # kernel on-chip): the donated pool was never consumed —
-                # drop to per-token steps instead of killing every stream
-                log.warning(
-                    "speculative step failed (%r); disabling speculation",
-                    exc,
-                )
-                self.speculate = False
-                METRICS.incr("scheduler.spec_disabled")
-                return False
-            raise  # pool consumed mid-execution: let _fail_all handle it
-        accept = 0
-        while (
-            accept < self.spec_draft_len
-            and draft[accept] == int(greedy[accept])
-        ):
-            accept += 1
-        # greedy[:accept + 1] are all model-chosen tokens (verified draft
-        # prefix + the bonus token)
-        METRICS.incr("scheduler.spec_steps")
-        METRICS.incr("scheduler.spec_accepted", accept)
-        delivered = 0
-        for t in [int(g) for g in greedy[: accept + 1]]:
-            self._deliver(s, t)
-            if s.finished:
-                break
-            delivered += 1
-            if s.grammar is not None and s.gstate >= 0:
-                # the tool-call trigger completed inside this block: the
-                # remaining verified tokens were sampled UNCONSTRAINED —
-                # drop them; the constrained phase re-decodes under the
-                # DFA mask from here
-                break
-        if not s.finished:
-            # KV is real through L0 + delivered - 1; the next fed token is
-            # s.next_input at position L0 + delivered. The block wrote T
-            # rows, so shrink the slot's length — inactive slots' lengths
-            # return to 0 (their writes landed in the null page)
-            lengths = np.zeros((self.B,), dtype=np.int32)
-            lengths[b] = L0 + delivered
-            self._pool = self._pool._replace(lengths=jnp.asarray(lengths))
-        return True
-
-    def _spec_fn(self, T: int):
-        key = ("spec", T)
-        if key not in self._step_jit:
-            cfg = self.engine.cfg
-            mesh = self.engine.mesh
-
-            def spec(params, pool, tokens):
-                from fei_tpu.models.llama import forward_paged_block
-
-                logits, pool = forward_paged_block(
-                    params, cfg, tokens, pool, kernel_mesh=mesh
-                )
-                return jnp.argmax(logits, axis=-1).astype(jnp.int32), pool
-
-            self._step_jit[key] = jax.jit(spec, donate_argnums=(1,))
-        return self._step_jit[key]
-
-    def _step_active(self) -> None:
-        eng = self.engine
-        B, V = self.B, eng.cfg.vocab_size
-        if self._maybe_spec_step():
-            return
-        if self._try_multi_step():
-            return
-        # evaluate per-request masks FIRST: a user mask_fn that raises (or
-        # returns an over-wide mask) must kill only its own request, never
-        # the other in-flight sequences or the pool
-        masks: dict[int, np.ndarray] = {}
-        for b, s in list(enumerate(self._slots)):
-            if s is None or s.prefilling or s.mask_fn is None:
-                continue
-            try:
-                m = self._host_mask(s)
-            except BaseException as exc:  # noqa: BLE001
-                s.out.put(exc)
-                self._finish(s)
-                continue
-            if m is not None:
-                masks[b] = m
-        # decode only runs for armed slots; chunk-prefilling slots write to
-        # the null page (their table row is still zeroed) and are skipped
-        active = [
-            (b, s) for b, s in enumerate(self._slots)
-            if s is not None and not s.prefilling
-        ]
-        if not active:
-            return
-
-        masked = bool(masks)
-        mask = None
-        if masked:
-            mask = np.ones((B, V), dtype=bool)
-            for b, m in masks.items():
-                mask[b] = m
-            # every host-evaluated mask pays a [B, V] upload — the metric
-            # the device-native grammar path is measured against
-            METRICS.incr("scheduler.host_mask_uploads", len(masks))
-        toks = self._dispatch_steps(active, 1, mask=mask)
-        for b, s in active:
-            # defensive symmetry with the multi-step loop; with n=1 nothing
-            # can replace a slot between assembly and delivery
-            if self._slots[b] is not s:
-                continue
-            self._deliver(s, int(toks[b, 0]))
-
-    def _try_multi_step(self) -> bool:
-        """Run up to ``self.multistep`` decode steps in ONE device dispatch.
-
-        Eligible only when the host has nothing to do between steps: no
-        queued or in-flight admission, every armed slot maskless and not
-        in a grammar free phase (the trigger scanner must see each token
-        as it streams), and every slot has >= N budget left — so tokens
-        decoded past a mid-scan stop stay inside the slot's reserved
-        pages (they are never delivered, and prefix-cache registration
-        only covers delivered tokens, so garbage positions are
-        unreachable). Constrained slots are fine: the scan advances their
-        DFA states on device exactly like the dense fused path."""
-        cap = self.multistep
-        if cap <= 1 or self._waiting or self._admitting is not None:
-            return False
-        active = [(b, s) for b, s in enumerate(self._slots) if s is not None]
-        if not active:
-            return False
-        for _, s in active:
-            if s.prefilling or s.mask_fn is not None:
-                return False
-            if s.grammar is not None and s.gstate < 0:
-                return False
-        headroom = min(s.budget - len(s.generated) for _, s in active)
-        n = 1
-        while n * 2 <= min(cap, headroom):
-            n *= 2
-        if n <= 1:
-            return False
-
-        toks = self._dispatch_steps(active, n)
-        METRICS.incr("scheduler.multi_steps")
-        METRICS.incr("scheduler.multi_tokens", n)
-        for i in range(n):
-            for b, s in active:
-                if self._slots[b] is not s:  # finished at an earlier step
-                    continue
-                self._deliver(s, int(toks[b, i]))
-        return True
-
-    def _dispatch_steps(
-        self, active, n: int, mask: np.ndarray | None = None
-    ) -> np.ndarray:
-        """Assemble the [B] batch vectors from ``active`` slots and run
-        ``n`` scanned decode steps in one compiled dispatch; returns the
-        sampled tokens [B, n] (ONE host sync for the whole scan). A host
-        ``mask`` ([B, V] bool) only composes with n == 1 — host masks must
-        be re-evaluated between steps."""
-        eng = self.engine
-        B = self.B
-        tokens = np.zeros((B, 1), dtype=np.int32)
-        temps = np.zeros((B,), dtype=np.float32)
-        topks = np.zeros((B,), dtype=np.int32)
-        topps = np.ones((B,), dtype=np.float32)
-        minps = np.zeros((B,), dtype=np.float32)
-        gstates = np.full((B,), -1, dtype=np.int32)
-        gremain = np.zeros((B,), dtype=np.int32)
-        grammared = False
-        for b, s in active:
-            tokens[b, 0] = s.next_input
-            temps[b] = s.gen.temperature
-            topks[b] = s.gen.top_k
-            topps[b] = s.gen.top_p
-            minps[b] = s.gen.min_p
-            if s.grammar is not None and s.gstate >= 0:
-                # the [B] state/budget vectors ride the same upload as the
-                # token ids; the [S, V] table never leaves the device
-                gstates[b] = s.gstate
-                gremain[b] = s.budget - len(s.generated)
-                grammared = True
-        step = self._multi_fn(n, grammared, masked=mask is not None)
-        args = [eng.params, self._pool, jnp.asarray(tokens), self._keys,
-                jnp.asarray(temps), jnp.asarray(topks), jnp.asarray(topps),
-                jnp.asarray(minps)]
-        kw = {}
-        if grammared:
-            kw.update(
-                gstates=jnp.asarray(gstates), gremain=jnp.asarray(gremain),
-                table=self._gtable, mind=self._gmind,
-            )
-        if mask is not None:
-            kw["mask"] = jnp.asarray(mask)
-        with METRICS.span("decode_step"):
-            nxt, self._pool, self._keys = step(*args, **kw)
-            return np.asarray(nxt)  # host sync inside the span
-
-    def _multi_fn(self, n_steps: int, grammared: bool, masked: bool = False):
-        """The scanned decode-step program: every scheduler decode — the
-        single step (n=1, optionally host-masked) and the multi-step turbo
-        scan — shares this one body, so grammar/sampling semantics cannot
-        drift between paths."""
-        key = ("multi", n_steps, grammared, masked)
-        if key not in self._step_jit:
-            cfg = self.engine.cfg
-            mesh = self.engine.mesh  # tp mesh: kernel runs via shard_map
-
-            def multi(params, pool, tokens, keys, temps, topks, topps,
-                      minps, gstates=None, gremain=None, table=None,
-                      mind=None, mask=None):
-                from fei_tpu.engine.grammar import feasible_mask
-
-                def body(carry, _):
-                    if grammared:
-                        pool, tokens, keys, gstates, gremain = carry
-                    else:
-                        pool, tokens, keys = carry
-                    logits, pool = forward_paged(
-                        params, cfg, tokens, pool, kernel_mesh=mesh
-                    )
-                    logits = logits[:, -1, :]
-                    if grammared:
-                        # per-slot DFA mask, entirely on device: slots with
-                        # gstate < 0 (free/unconstrained) pass through.
-                        # Budget feasibility is the shared rule
-                        # (grammar.feasible_mask, same as the dense scan).
-                        use = gstates >= 0
-                        srow = table[jnp.maximum(gstates, 0)]  # [B, V]
-                        gmask = feasible_mask(srow, mind, gremain, xp=jnp)
-                        gmask = jnp.where(use[:, None], gmask, True)
-                        logits = jnp.where(gmask, logits, -jnp.inf)
-                    if masked:
-                        logits = jnp.where(mask, logits, -jnp.inf)
-                    outs = jax.vmap(jax.random.split)(keys)  # [B, 2, 2]
-                    new_keys, subs = outs[:, 0], outs[:, 1]
-                    nxt = sample_logits_dynamic(
-                        logits, subs, temps, topks, topps, minps
-                    )
-                    if grammared:
-                        nstate = jnp.take_along_axis(
-                            srow, nxt[:, None], axis=1
-                        )[:, 0].astype(jnp.int32)
-                        gstates = jnp.where(use, nstate, gstates)
-                        gremain = jnp.where(use, gremain - 1, gremain)
-                        carry = (pool, nxt[:, None], new_keys, gstates, gremain)
-                    else:
-                        carry = (pool, nxt[:, None], new_keys)
-                    return carry, nxt
-
-                init = (
-                    (pool, tokens, keys, gstates, gremain) if grammared
-                    else (pool, tokens, keys)
-                )
-                carry, toks = jax.lax.scan(body, init, None, length=n_steps)
-                return jnp.swapaxes(toks, 0, 1), carry[0], carry[2]
-
-            self._step_jit[key] = jax.jit(multi, donate_argnums=(1,))
-        return self._step_jit[key]
 
     def _finish(self, seq: _Seq) -> None:
         seq.finished = True
@@ -1381,7 +514,7 @@ class PagedScheduler:
             s.finished = True
             s.out.put(exc)
 
-    # -- device programs ----------------------------------------------------
+    # -- shared device state ------------------------------------------------
 
     def _ensure_pool(self) -> None:
         # under self._lock: two submitter threads must not double-create the
@@ -1408,101 +541,4 @@ class PagedScheduler:
             )
         except Exception:  # noqa: BLE001 — be conservative
             return False
-
-    def _grammar_first_mask(self, seq: _Seq) -> np.ndarray:
-        """Entry-state mask (with the dense path's budget-feasibility rule)
-        for a device-grammar request's first sampled token."""
-        from fei_tpu.engine.engine import pad_vocab_mask
-        from fei_tpu.engine.grammar import feasible_mask
-
-        g = seq.grammar
-        m = feasible_mask(g.table[seq.gstate], g.min_dist, seq.budget)
-        return pad_vocab_mask(m, self.engine.cfg.vocab_size, xp=np)
-
-    def _host_mask(self, seq: _Seq, first: bool = False) -> np.ndarray | None:
-        if seq.mask_fn is None:
-            return None
-        m = seq.mask_fn([] if first else seq.generated)
-        if m is None:
-            return None
-        from fei_tpu.engine.engine import pad_vocab_mask
-
-        return pad_vocab_mask(
-            np.asarray(m, dtype=bool), self.engine.cfg.vocab_size, xp=np
-        )
-
-    def _admit_fn(self, bucket: int, n_pages: int):
-        key = (bucket, n_pages)
-        if key not in self._admit_jit:
-            cfg = self.engine.cfg
-            ps = self.engine.page_size
-
-            def admit(pool, k_dense, v_dense, page_ids, row, slot, length, start):
-                # k_dense/v_dense: [L, 1, S, K, D] with S = bucket; only
-                # tokens [start, start + n_pages*ps) scatter (prefix-cached
-                # pages before `start` already hold their K/V). ``start`` is
-                # traced so prefix lengths don't multiply compile variants.
-                L, _, S, K, D = k_dense.shape
-                need = n_pages * ps
-
-                k_scl = v_scl = None
-                if pool.quantized:
-                    from fei_tpu.engine.paged_cache import quant_kv_rows
-
-                    k_dense, ks = quant_kv_rows(k_dense)  # int8 + [L,1,S,K]
-                    v_dense, vs = quant_kv_rows(v_dense)
-
-                def pagesof(x):
-                    if S < need:
-                        x = jnp.pad(
-                            x, ((0, 0), (0, 0), (0, need - S), (0, 0), (0, 0))
-                        )
-                    x = jax.lax.dynamic_slice_in_dim(x, start, need, axis=2)
-                    # [L, 1, n*ps, K, D] -> [n, L, K, ps, D]
-                    x = x.reshape(L, n_pages, ps, K, D)
-                    return jnp.transpose(x, (1, 0, 3, 2, 4))
-
-                def scalesof(s):
-                    if S < need:
-                        s = jnp.pad(s, ((0, 0), (0, 0), (0, need - S), (0, 0)))
-                    s = jax.lax.dynamic_slice_in_dim(s, start, need, axis=2)
-                    # [L, 1, n*ps, K] -> [n, L, K, 1, ps]
-                    s = s.reshape(L, n_pages, ps, K)
-                    return jnp.transpose(s, (1, 0, 3, 2))[:, :, :, None, :]
-
-                if pool.quantized:
-                    k_scl, v_scl = scalesof(ks), scalesof(vs)
-                kp, vp = pagesof(k_dense), pagesof(v_dense)
-                k_pool, v_pool = pool.k_pages, pool.v_pages
-                k_spool, v_spool = pool.k_scales, pool.v_scales
-                for i in range(n_pages):
-                    at = (0, page_ids[i], 0, 0, 0)
-                    k_pool = jax.lax.dynamic_update_slice(
-                        k_pool, kp[i][:, None].astype(k_pool.dtype), at
-                    )
-                    v_pool = jax.lax.dynamic_update_slice(
-                        v_pool, vp[i][:, None].astype(v_pool.dtype), at
-                    )
-                    if pool.quantized:
-                        k_spool = jax.lax.dynamic_update_slice(
-                            k_spool, k_scl[i][:, None], at
-                        )
-                        v_spool = jax.lax.dynamic_update_slice(
-                            v_spool, v_scl[i][:, None], at
-                        )
-                bt = jax.lax.dynamic_update_slice(
-                    pool.block_table, row[None, :], (slot, 0)
-                )
-                ln = jax.lax.dynamic_update_slice(
-                    pool.lengths, length[None], (slot,)
-                )
-                return pool._replace(
-                    k_pages=k_pool, v_pages=v_pool, block_table=bt, lengths=ln,
-                    k_scales=k_spool, v_scales=v_spool,
-                )
-
-            # only the pool is donated: the dense prefill K/V are reshaped
-            # (layout change), so XLA could not reuse their buffers anyway
-            self._admit_jit[key] = jax.jit(admit, donate_argnums=(0,))
-        return self._admit_jit[key]
 
