@@ -184,6 +184,7 @@ def _prompt_and_trigger(engine, gen) -> tuple[list[int], str]:
     pytest.skip("no prompt yields a clean ASCII first token for this model")
 
 
+@pytest.mark.slow  # fast lane: -m 'not slow'
 class TestEngineToolcallStream:
     def test_fused_constrained_call_parses(self):
         engine = InferenceEngine.from_config("tiny")
@@ -315,6 +316,7 @@ def _provider_trigger(provider, messages, system, tools) -> str:
     return None  # no fixed point for this prompt; caller varies the message
 
 
+@pytest.mark.slow  # fast lane: -m 'not slow'
 class TestProviderConstrained:
     def _provider(self, paged: bool = False):
         from fei_tpu.agent.providers import JaxLocalProvider

@@ -9,6 +9,9 @@ import sys
 import textwrap
 
 import fei_tpu.parallel.distributed as dist
+import pytest
+
+pytestmark = pytest.mark.slow  # fast lane: -m 'not slow' (docs/TESTING.md)
 
 
 class TestDistributed:

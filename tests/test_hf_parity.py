@@ -19,6 +19,8 @@ from fei_tpu.engine.weights import load_checkpoint
 from fei_tpu.models.configs import get_model_config
 from fei_tpu.models.llama import KVCache, forward
 
+pytestmark = pytest.mark.slow  # fast lane: -m 'not slow' (docs/TESTING.md)
+
 
 def _tiny_hf_llama(tmp_path, tie_embeddings=False, attention_bias=False):
     cfg = transformers.LlamaConfig(

@@ -130,6 +130,7 @@ class TestMixedTreeRules:
         assert isinstance(out["layers"]["wq"], QTensor)
 
 
+@pytest.mark.slow  # fast lane: -m 'not slow'
 class TestEngineInt4:
     def test_greedy_decode_matches_dequantized_oracle(self):
         """The engine e2e contract: an int4 engine decodes token-identically
@@ -261,6 +262,7 @@ class TestEngineInt4:
         finally:
             paged.close()
 
+@pytest.mark.slow  # fast lane: -m 'not slow'
 class TestInt4Mesh:
     """Mesh composition tests — need multiple devices (the on-chip pipeline
     runs this file against the single real chip: these must skip, not

@@ -12,6 +12,8 @@ from fei_tpu.models.llama import KVCache, forward, init_params
 from fei_tpu.parallel.long_prefill import prefill_ring
 from fei_tpu.parallel.mesh import make_mesh
 
+pytestmark = pytest.mark.slow  # fast lane: -m 'not slow' (docs/TESTING.md)
+
 
 @pytest.fixture(scope="module")
 def setup():

@@ -21,6 +21,8 @@ from fei_tpu.engine.engine import GenerationConfig, InferenceEngine
 from fei_tpu.parallel.mesh import make_mesh
 from fei_tpu.utils.metrics import METRICS
 
+pytestmark = pytest.mark.slow  # fast lane: -m 'not slow' (docs/TESTING.md)
+
 
 def _sp_prefills() -> float:
     return METRICS.snapshot()["counters"].get("engine.sp_prefills", 0)

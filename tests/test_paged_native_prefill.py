@@ -14,6 +14,8 @@ import pytest
 
 from fei_tpu.engine.engine import GenerationConfig, InferenceEngine
 
+pytestmark = pytest.mark.slow  # fast lane: -m 'not slow' (docs/TESTING.md)
+
 PROMPT = [(7 * i + 11) % 200 + 10 for i in range(560)]  # 2 chunks + partial
 GEN = GenerationConfig(max_new_tokens=12, ignore_eos=True)
 

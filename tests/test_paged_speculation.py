@@ -16,6 +16,8 @@ import pytest
 from fei_tpu.engine.engine import GenerationConfig, InferenceEngine
 from fei_tpu.utils.metrics import METRICS
 
+pytestmark = pytest.mark.slow  # fast lane: -m 'not slow' (docs/TESTING.md)
+
 
 def _counter(name: str) -> float:
     return METRICS.snapshot()["counters"].get(name, 0)

@@ -25,6 +25,8 @@ from fei_tpu.engine.grammar import (
 )
 from fei_tpu.utils.metrics import METRICS
 
+pytestmark = pytest.mark.slow  # fast lane: -m 'not slow' (docs/TESTING.md)
+
 SCHEMA = {
     "type": "object",
     "properties": {"path": {"type": "string"}},

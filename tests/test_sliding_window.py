@@ -109,6 +109,7 @@ class TestFlashWindow:
             )
 
 
+@pytest.mark.slow  # fast lane: -m 'not slow'
 class TestEngineSWA:
     def test_decode_honors_window_across_cache_growth(self):
         """Greedy decode with a window smaller than the context must match
@@ -203,6 +204,7 @@ class TestEngineSWA:
         assert np.abs(np.asarray(got) - np.asarray(full)).max() > 1e-3
 
 
+@pytest.mark.slow  # fast lane: -m 'not slow'
 class TestRollingBuffer:
     def test_release_prefix_refcounts(self):
         from fei_tpu.engine.paged_cache import PageAllocator
@@ -332,6 +334,7 @@ transformers = pytest.importorskip("transformers")
 torch = pytest.importorskip("torch")
 
 
+@pytest.mark.slow  # fast lane: -m 'not slow'
 class TestMistralParity:
     def test_logits_match_with_window_biting(self, tmp_path):
         """Golden parity vs HF MistralForCausalLM with sliding_window=4 at

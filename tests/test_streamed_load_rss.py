@@ -47,6 +47,8 @@ from fei_tpu.models.configs import get_model_config
 from fei_tpu.parallel.mesh import make_mesh
 from fei_tpu.parallel.sharding import param_shardings_from_cfg
 
+pytestmark = pytest.mark.slow  # fast lane: -m 'not slow' (docs/TESTING.md)
+
 ckpt, cfg_kw = sys.argv[1], json.loads(sys.argv[2])
 
 def maxrss():
