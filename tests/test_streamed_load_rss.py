@@ -31,6 +31,8 @@ safetensors = pytest.importorskip("safetensors.numpy")
 
 from tests.test_streamed_load import _write_hf_llama  # noqa: E402
 
+pytestmark = pytest.mark.slow  # fast lane: -m 'not slow' (docs/TESTING.md)
+
 _CFG_KW = dict(
     num_layers=10, hidden_size=1024, intermediate_size=3584,
     num_heads=16, num_kv_heads=8, vocab_size=4096, max_seq_len=256,
@@ -46,8 +48,6 @@ from fei_tpu.engine.weights import load_checkpoint
 from fei_tpu.models.configs import get_model_config
 from fei_tpu.parallel.mesh import make_mesh
 from fei_tpu.parallel.sharding import param_shardings_from_cfg
-
-pytestmark = pytest.mark.slow  # fast lane: -m 'not slow' (docs/TESTING.md)
 
 ckpt, cfg_kw = sys.argv[1], json.loads(sys.argv[2])
 
