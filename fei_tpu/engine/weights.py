@@ -67,6 +67,25 @@ _TOP_MAP = {
     "final_norm": "model.norm.weight",
     "lm_head": "lm_head.weight",
 }
+# Phi family (PhiForCausalLM): o_proj is `dense`, the MLP is fc1/fc2 (our
+# w_gate/w_down leaves), LayerNorms carry biases, the final norm is
+# `final_layernorm`, and lm_head has a bias
+_PHI_LAYER_MAP = {
+    **_LAYER_MAP,
+    "wo": "model.layers.{i}.self_attn.dense.weight",
+    "bo": "model.layers.{i}.self_attn.dense.bias",
+    "attn_norm_b": "model.layers.{i}.input_layernorm.bias",
+    "w_gate": "model.layers.{i}.mlp.fc1.weight",
+    "b_gate": "model.layers.{i}.mlp.fc1.bias",
+    "w_down": "model.layers.{i}.mlp.fc2.weight",
+    "b_down": "model.layers.{i}.mlp.fc2.bias",
+}
+_PHI_TOP_MAP = {
+    **_TOP_MAP,
+    "final_norm": "model.final_layernorm.weight",
+    "final_norm_b": "model.final_layernorm.bias",
+    "lm_head_b": "lm_head.bias",
+}
 # HF stores linear weights as [out, in]; our pytree uses [in, out] so the
 # forward is x @ w (the plan builders mark these transpose=True).
 
@@ -202,12 +221,16 @@ def _plans(reader: _ShardReader, cfg: ModelConfig) -> dict:
     h, d = cfg.hidden_size, cfg.head_dim_
     H, K, I = cfg.num_heads, cfg.num_kv_heads, cfg.intermediate_size
     L, V = cfg.num_layers, cfg.vocab_size
+    # Phi family is identified structurally (shared-norm parallel block)
+    phi = cfg.parallel_block
+    lmap = _PHI_LAYER_MAP if phi else _LAYER_MAP
+    tmap = _PHI_TOP_MAP if phi else _TOP_MAP
 
     def hf_shape(shape, transpose):
         return tuple(reversed(shape)) if transpose and len(shape) == 2 else shape
 
     def top(name, shape, transpose):
-        hf = _TOP_MAP[name]
+        hf = tmap[name]
         expect = hf_shape(shape, transpose)
         return _TensorPlan(
             shape, lambda idx: reader.read(hf, idx, transpose, expect)
@@ -252,23 +275,33 @@ def _plans(reader: _ShardReader, cfg: ModelConfig) -> dict:
     plans = {
         ("embed",): top("embed", (V, h), False),
         ("final_norm",): top("final_norm", (h,), False),
-        ("layers", "attn_norm"): stacked(_LAYER_MAP["attn_norm"], (h,), False
+        ("layers", "attn_norm"): stacked(lmap["attn_norm"], (h,), False
         ),
-        ("layers", "mlp_norm"): stacked(_LAYER_MAP["mlp_norm"], (h,), False
-        ),
-        ("layers", "wq"): stacked(_LAYER_MAP["wq"], (h, H * d), True),
-        ("layers", "wk"): stacked(_LAYER_MAP["wk"], (h, K * d), True),
-        ("layers", "wv"): stacked(_LAYER_MAP["wv"], (h, K * d), True),
-        ("layers", "wo"): stacked(_LAYER_MAP["wo"], (H * d, h), True),
+        ("layers", "wq"): stacked(lmap["wq"], (h, H * d), True),
+        ("layers", "wk"): stacked(lmap["wk"], (h, K * d), True),
+        ("layers", "wv"): stacked(lmap["wv"], (h, K * d), True),
+        ("layers", "wo"): stacked(lmap["wo"], (H * d, h), True),
     }
+    if not cfg.parallel_block:
+        plans[("layers", "mlp_norm")] = stacked(lmap["mlp_norm"], (h,), False)
+    if cfg.norm_kind == "layernorm":
+        # only the Phi maps carry bias names today; a non-parallel-block
+        # layernorm family (GPT-NeoX-style) would need its own map entries
+        # including a distinct mlp_norm_b
+        plans[("layers", "attn_norm_b")] = stacked(
+            lmap["attn_norm_b"], (h,), False
+        )
+        plans[("final_norm_b",)] = top("final_norm_b", (h,), False)
     if cfg.attn_bias:
-        plans[("layers", "bq")] = stacked(_LAYER_MAP["bq"], (H * d,), False)
-        plans[("layers", "bk")] = stacked(_LAYER_MAP["bk"], (K * d,), False)
-        plans[("layers", "bv")] = stacked(_LAYER_MAP["bv"], (K * d,), False)
+        plans[("layers", "bq")] = stacked(lmap["bq"], (H * d,), False)
+        plans[("layers", "bk")] = stacked(lmap["bk"], (K * d,), False)
+        plans[("layers", "bv")] = stacked(lmap["bv"], (K * d,), False)
     if cfg.o_bias:
-        plans[("layers", "bo")] = stacked(_LAYER_MAP["bo"], (h,), False)
+        plans[("layers", "bo")] = stacked(lmap["bo"], (h,), False)
     if not cfg.tie_embeddings:
         plans[("lm_head",)] = top("lm_head", (h, V), True)
+        if cfg.lm_head_bias:
+            plans[("lm_head_b",)] = top("lm_head_b", (V,), False)
     if cfg.is_moe:
         plans[("layers", "router")] = stacked(_MOE_LAYER_MAP["router"], (h, cfg.num_experts), True
         )
@@ -279,11 +312,15 @@ def _plans(reader: _ShardReader, cfg: ModelConfig) -> dict:
         plans[("layers", "w_down")] = stacked_experts(_MOE_LAYER_MAP["w_down"], (I, h)
         )
     else:
-        plans[("layers", "w_gate")] = stacked(_LAYER_MAP["w_gate"], (h, I), True
+        plans[("layers", "w_gate")] = stacked(lmap["w_gate"], (h, I), True
         )
-        plans[("layers", "w_up")] = stacked(_LAYER_MAP["w_up"], (h, I), True
-        )
-        plans[("layers", "w_down")] = stacked(_LAYER_MAP["w_down"], (I, h), True
+        if cfg.mlp_gated:
+            plans[("layers", "w_up")] = stacked(lmap["w_up"], (h, I), True
+            )
+        elif cfg.mlp_bias:  # Phi fc1/fc2 biases
+            plans[("layers", "b_gate")] = stacked(lmap["b_gate"], (I,), False)
+            plans[("layers", "b_down")] = stacked(lmap["b_down"], (h,), False)
+        plans[("layers", "w_down")] = stacked(lmap["w_down"], (I, h), True
         )
     return plans
 
@@ -677,6 +714,29 @@ def _merge_hf_config(ckpt_dir: str, cfg: ModelConfig) -> ModelConfig:
         ),
         head_dim=hf.get("head_dim"),
     )
+    if hf.get("model_type") == "phi":
+        # Phi: LayerNorm + shared-norm parallel block, partial rotary
+        # (rotary_dim = partial_rotary_factor * head_dim), fc1/fc2 MLP with
+        # biases, biased qkv/dense/lm_head. PhiConfig spells the norm eps
+        # layer_norm_eps; rms_norm_eps carries it into _norm.
+        n_heads = hf.get("num_attention_heads") or cfg.num_heads
+        head_dim = (hf.get("hidden_size") or cfg.hidden_size) // n_heads
+        fields.update(
+            norm_kind="layernorm",
+            parallel_block=True,
+            mlp_gated=False,
+            mlp_bias=True,
+            attn_bias=True,
+            o_bias=True,
+            lm_head_bias=True,
+            hidden_act="gelu",
+            rms_norm_eps=hf.get("layer_norm_eps"),
+            rotary_dim=int(
+                (hf.get("partial_rotary_factor") or 0.5) * head_dim
+            ),
+            # PhiConfig has no num_key_value_heads by default (MHA)
+            num_kv_heads=hf.get("num_key_value_heads") or n_heads,
+        )
     if hf.get("model_type") == "gemma":
         # Gemma: zero-centered norm weights ((1+w) multiply), sqrt(h)-scaled
         # embeddings, GeGLU. HF spells the activation hidden_activation

@@ -41,6 +41,16 @@ class ModelConfig:
     # Mistral-v0.1-style sliding-window attention: each query attends to at
     # most the last `sliding_window` positions (None = full causal)
     sliding_window: int | None = None
+    # Phi family: LayerNorm (with bias) instead of RMSNorm, ONE shared norm
+    # feeding attention AND MLP in parallel (x + attn(ln x) + mlp(ln x)),
+    # partial rotary (first `rotary_dim` dims of each head), non-gated
+    # fc1/act/fc2 MLP with biases, and a biased LM head
+    norm_kind: str = "rms"  # "rms" | "layernorm"
+    parallel_block: bool = False
+    rotary_dim: int = 0  # 0 = rotate the full head_dim
+    mlp_gated: bool = True
+    mlp_bias: bool = False
+    lm_head_bias: bool = False
     # tokenizer/bos/eos defaults (overridden by a real tokenizer when loaded)
     bos_token_id: int = 1
     eos_token_id: int = 2
@@ -48,6 +58,11 @@ class ModelConfig:
     @property
     def head_dim_(self) -> int:
         return self.head_dim if self.head_dim is not None else self.hidden_size // self.num_heads
+
+    @property
+    def rope_dim_(self) -> int:
+        """Head dims that rotate: `rotary_dim` when partial (Phi), else all."""
+        return self.rotary_dim or self.head_dim_
 
     @property
     def is_moe(self) -> bool:
@@ -65,8 +80,8 @@ class ModelConfig:
         if self.is_moe:
             mlp = self.num_experts * 3 * h * i + h * self.num_experts
         else:
-            mlp = 3 * h * i
-        norms = 2 * h
+            mlp = (3 if self.mlp_gated else 2) * h * i
+        norms = (1 if self.parallel_block else 2) * h
         embed = v * h * (1 if self.tie_embeddings else 2)
         return L * (attn + mlp + norms) + embed + h
 
@@ -88,7 +103,7 @@ class ModelConfig:
         if self.is_moe:
             mlp = self.num_experts_per_tok * 3 * h * i + h * self.num_experts
         else:
-            mlp = 3 * h * i
+            mlp = (3 if self.mlp_gated else 2) * h * i
         return L * (attn + mlp) + v * h
 
 
@@ -169,6 +184,26 @@ MODEL_CONFIGS: dict[str, ModelConfig] = {
         name="mistral-7b", vocab_size=32000, hidden_size=4096,
         intermediate_size=14336, num_layers=32, num_heads=32, num_kv_heads=8,
         rope_theta=10000.0, max_seq_len=32768, sliding_window=4096,
+    ),
+    # Phi family (parallel attn+MLP block, LayerNorm, partial rotary).
+    # phi-2 is the architecture the reference's node-onboarding doc mocks at
+    # "67 tokens/s" on a hypothetical RTX 3080
+    # (/root/reference/docs/HOW_FEI_NETWORK_WORKS.md:60-75) — here it runs
+    # for real, in-tree, on TPU (2.7B bf16 = 5.6 GB: fits one v5e chip).
+    "tiny-phi": ModelConfig(
+        name="tiny-phi", vocab_size=512, hidden_size=64,
+        intermediate_size=256, num_layers=2, num_heads=4, num_kv_heads=4,
+        max_seq_len=2048, rope_theta=10000.0, norm_kind="layernorm",
+        parallel_block=True, rotary_dim=8, mlp_gated=False, mlp_bias=True,
+        attn_bias=True, o_bias=True, lm_head_bias=True, hidden_act="gelu",
+    ),
+    "phi-2": ModelConfig(
+        name="phi-2", vocab_size=51200, hidden_size=2560,
+        intermediate_size=10240, num_layers=32, num_heads=32, num_kv_heads=32,
+        max_seq_len=2048, rope_theta=10000.0, norm_kind="layernorm",
+        parallel_block=True, rotary_dim=32, mlp_gated=False, mlp_bias=True,
+        attn_bias=True, o_bias=True, lm_head_bias=True, hidden_act="gelu",
+        bos_token_id=50256, eos_token_id=50256,
     ),
     # Gemma family (norm offset, GeGLU, scaled embeddings, head_dim 256,
     # always-tied embeddings, rope 10000)

@@ -137,8 +137,13 @@ def init_params(
             "wk": init(next(keys), (L, h, K * d), h, quant=True, name="wk"),
             "wv": init(next(keys), (L, h, K * d), h, quant=True, name="wv"),
             "wo": init(next(keys), (L, H * d, h), H * d, quant=True, name="wo"),
-            "mlp_norm": ninit((L, h), dtype=dtype),
         }
+        if not cfg.parallel_block:  # Phi's ONE shared norm feeds attn + mlp
+            layers["mlp_norm"] = ninit((L, h), dtype=dtype)
+        if cfg.norm_kind == "layernorm":  # Phi: LayerNorm carries biases
+            layers["attn_norm_b"] = jnp.zeros((L, h), dtype=dtype)
+            if not cfg.parallel_block:
+                layers["mlp_norm_b"] = jnp.zeros((L, h), dtype=dtype)
         if cfg.attn_bias:  # Qwen2-style qkv biases
             layers.update(
                 bq=jnp.zeros((L, H * d), dtype=dtype),
@@ -155,12 +160,24 @@ def init_params(
                 w_up=init(next(keys), (L, E, h, I), h, quant=True, name="w_up"),
                 w_down=init(next(keys), (L, E, I, h), I, quant=True, name="w_down"),
             )
-        else:
+        elif cfg.mlp_gated:
             layers.update(
                 w_gate=init(next(keys), (L, h, I), h, quant=True, name="w_gate"),
                 w_up=init(next(keys), (L, h, I), h, quant=True, name="w_up"),
                 w_down=init(next(keys), (L, I, h), I, quant=True, name="w_down"),
             )
+        else:
+            # Phi fc1/fc2 reuse the w_gate/w_down leaves (same column/row
+            # sharding + quantization rules); no w_up
+            layers.update(
+                w_gate=init(next(keys), (L, h, I), h, quant=True, name="w_gate"),
+                w_down=init(next(keys), (L, I, h), I, quant=True, name="w_down"),
+            )
+            if cfg.mlp_bias:
+                layers.update(
+                    b_gate=jnp.zeros((L, I), dtype=dtype),
+                    b_down=jnp.zeros((L, h), dtype=dtype),
+                )
         # FEI_TPU_QUANT_EMBED=1 (with any quantize mode): int8 embed table
         # with per-row scales — halves embed HBM, and for tie_embeddings
         # models halves the LM-head stream (ops.quant.quantize_embed)
@@ -175,10 +192,14 @@ def init_params(
             "layers": layers,
             "final_norm": ninit((h,), dtype=dtype),
         }
+        if cfg.norm_kind == "layernorm":
+            params["final_norm_b"] = jnp.zeros((h,), dtype=dtype)
         if not cfg.tie_embeddings:
             params["lm_head"] = init(
                 next(keys), (h, cfg.vocab_size), h, quant=True, name="lm_head"
             )
+            if cfg.lm_head_bias:
+                params["lm_head_b"] = jnp.zeros((cfg.vocab_size,), dtype=dtype)
         return params
 
     built = jax.jit(_build)
@@ -234,8 +255,53 @@ def _moe(cfg: ModelConfig, y, lp, allow_routed: bool, moe_mesh=None):
     return fn(*args)
 
 
-def _norm(x, w, cfg: ModelConfig):
+def _norm(x, w, cfg: ModelConfig, b=None):
+    """RMSNorm (Llama families) or LayerNorm with bias (Phi family,
+    cfg.norm_kind == "layernorm"; ``b`` is the bias leaf or None)."""
+    if cfg.norm_kind == "layernorm":
+        xf = x.astype(jnp.float32)
+        mu = jnp.mean(xf, axis=-1, keepdims=True)
+        var = jnp.mean(jnp.square(xf - mu), axis=-1, keepdims=True)
+        y = (xf - mu) * jax.lax.rsqrt(var + cfg.rms_norm_eps)
+        y = y * w.astype(jnp.float32)
+        if b is not None:
+            y = y + b.astype(jnp.float32)
+        return y.astype(x.dtype)
     return rms_norm(x, w, cfg.rms_norm_eps, offset=cfg.norm_offset)
+
+
+def _rope(x, cos, sin, positions, rope_dim: int):
+    """apply_rope over the first ``rope_dim`` head dims (Phi partial
+    rotary; the HF convention rotates the leading slice split-half and
+    passes the rest through), or the whole head when rope_dim covers it.
+    ``cos``/``sin`` tables are sized for ``rope_dim``."""
+    if rope_dim and rope_dim != x.shape[-1]:
+        return jnp.concatenate(
+            [apply_rope(x[..., :rope_dim], cos, sin, positions),
+             x[..., rope_dim:]],
+            axis=-1,
+        )
+    return apply_rope(x, cos, sin, positions)
+
+
+def _mlp_dense(cfg: ModelConfig, y, lp, kernel_mesh=None):
+    """The dense (non-MoE) MLP: gated SwiGLU/GeGLU (w_gate*w_up -> w_down)
+    for the Llama families, fc1 -> act -> fc2 with biases for Phi
+    (cfg.mlp_gated=False; fc1/fc2 reuse the w_gate/w_down leaves so the
+    column/row sharding and quantization rules apply unchanged)."""
+    if not cfg.mlp_gated:
+        a = _mm_k(y, lp["w_gate"], kernel_mesh)
+        if "b_gate" in lp:
+            a = a + lp["b_gate"]
+        act = _mlp_act(cfg, a.astype(jnp.float32)).astype(y.dtype)
+        out = mm(act, lp["w_down"])
+        if "b_down" in lp:
+            out = out + lp["b_down"]
+        return out
+    act = _mlp_act(
+        cfg, _mm_k(y, lp["w_gate"], kernel_mesh).astype(jnp.float32)
+    ).astype(y.dtype)
+    return mm(act * _mm_k(y, lp["w_up"], kernel_mesh), lp["w_down"])
 
 
 def _mlp_act(cfg: ModelConfig, gate):
@@ -336,10 +402,11 @@ def _layer(
     K, d = cfg.num_kv_heads, cfg.head_dim_
     Hq = cfg.num_heads
 
-    y = _norm(x, lp["attn_norm"], cfg)
+    y = _norm(x, lp["attn_norm"], cfg, b=lp.get("attn_norm_b"))
     q, k, v = qkv_proj(lp, y, Hq, K, d, kernel_mesh=kernel_mesh)
-    q = apply_rope(q, cos, sin, positions)
-    k = apply_rope(k, cos, sin, positions)
+    rd = cfg.rope_dim_
+    q = _rope(q, cos, sin, positions, rd)
+    k = _rope(k, cos, sin, positions, rd)
 
     if cache_k is None:
         new_k, new_v = k, v
@@ -358,16 +425,22 @@ def _layer(
     o = mm(attn_out.reshape(B, T, Hq * d), lp["wo"])
     if "bo" in lp:  # HF Llama attention_bias=true also biases o_proj
         o = o + lp["bo"]
+
+    if cfg.parallel_block:
+        # Phi: attention and MLP both read the ONE shared norm output and
+        # sum into the residual — x + attn(ln x) + mlp(ln x)
+        mlp_out = (
+            _moe(cfg, y, lp, allow_routed, moe_mesh) if cfg.is_moe
+            else _mlp_dense(cfg, y, lp, kernel_mesh)
+        )
+        return x + o + mlp_out, new_k, new_v
     x = x + o
 
-    y = _norm(x, lp["mlp_norm"], cfg)
+    y = _norm(x, lp["mlp_norm"], cfg, b=lp.get("mlp_norm_b"))
     if cfg.is_moe:
         mlp_out = _moe(cfg, y, lp, allow_routed, moe_mesh)
     else:
-        act = _mlp_act(
-            cfg, _mm_k(y, lp["w_gate"], kernel_mesh).astype(jnp.float32)
-        ).astype(y.dtype)
-        mlp_out = mm(act * _mm_k(y, lp["w_up"], kernel_mesh), lp["w_down"])
+        mlp_out = _mlp_dense(cfg, y, lp, kernel_mesh)
     return x + mlp_out, new_k, new_v
 
 
@@ -380,7 +453,10 @@ def _logits(x, params, cfg: ModelConfig, kernel_mesh=None) -> jnp.ndarray:
         from fei_tpu.ops.quant import tied_logits
 
         return tied_logits(x, params["embed"])
-    return _mm_k(x, params["lm_head"], kernel_mesh).astype(jnp.float32)
+    out = _mm_k(x, params["lm_head"], kernel_mesh).astype(jnp.float32)
+    if "lm_head_b" in params:  # Phi: biased LM head
+        out = out + params["lm_head_b"].astype(jnp.float32)
+    return out
 
 
 def forward(
@@ -405,7 +481,7 @@ def forward(
     """
     B, T = tokens.shape
     positions = cache.length[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
-    cos, sin = compute_rope_freqs(cfg.head_dim_, cache.k.shape[2], cfg.rope_theta)
+    cos, sin = compute_rope_freqs(cfg.rope_dim_, cache.k.shape[2], cfg.rope_theta)
 
     x = embed_tokens(params, cfg, tokens, cache.k.dtype)
 
@@ -423,7 +499,7 @@ def forward(
         body, x, (params["layers"], cache.k, cache.v)
     )
 
-    x = _norm(x, params["final_norm"], cfg)
+    x = _norm(x, params["final_norm"], cfg, b=params.get("final_norm_b"))
     new_cache = KVCache(k=new_k, v=new_v, length=cache.length + T)
     if not lm_head:
         return x, new_cache
@@ -499,7 +575,7 @@ def forward_paged_block(
     K, d, Hq = cfg.num_kv_heads, cfg.head_dim_, cfg.num_heads
     positions = cache.lengths[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
     max_pos = cache.block_table.shape[1] * cache.page_size
-    cos, sin = compute_rope_freqs(cfg.head_dim_, max_pos, cfg.rope_theta)
+    cos, sin = compute_rope_freqs(cfg.rope_dim_, max_pos, cfg.rope_theta)
     # kernel-selection policy: see the docstring
     block_kernel = T > 1 and os.environ.get("FEI_TPU_BLOCK_ATTN", "1") != "0"
     sharded = kernel_mesh is not None and kernel_mesh.shape.get("tp", 1) > 1
@@ -515,10 +591,10 @@ def forward_paged_block(
         else:
             lp, kp, vp = layer_inputs
             ksc = vsc = None
-        y = _norm(x, lp["attn_norm"], cfg)
+        y = _norm(x, lp["attn_norm"], cfg, b=lp.get("attn_norm_b"))
         q, k, v = qkv_proj(lp, y, Hq, K, d, kernel_mesh=kernel_mesh)
-        q = apply_rope(q, cos, sin, positions)
-        k = apply_rope(k, cos, sin, positions)
+        q = _rope(q, cos, sin, positions, cfg.rope_dim_)
+        k = _rope(k, cos, sin, positions, cfg.rope_dim_)
 
         # write all T positions' K/V (causality is the kernel's per-row
         # mask, so writing ahead of attending is safe)
@@ -563,17 +639,20 @@ def forward_paged_block(
         o = mm(attn.reshape(B, T, Hq * d), lp["wo"])
         if "bo" in lp:
             o = o + lp["bo"]
+        out = (kp, vp, ksc, vsc) if kv_int8 else (kp, vp)
+        if cfg.parallel_block:  # Phi: x + attn(ln x) + mlp(ln x)
+            mlp_out = (
+                _moe(cfg, y, lp, routed_moe, moe_mesh) if cfg.is_moe
+                else _mlp_dense(cfg, y, lp, kernel_mesh)
+            )
+            return x + o + mlp_out, out
         x = x + o
 
-        y = _norm(x, lp["mlp_norm"], cfg)
+        y = _norm(x, lp["mlp_norm"], cfg, b=lp.get("mlp_norm_b"))
         if cfg.is_moe:
             mlp_out = _moe(cfg, y, lp, routed_moe, moe_mesh)
         else:
-            act = _mlp_act(
-                cfg, _mm_k(y, lp["w_gate"], kernel_mesh).astype(jnp.float32)
-            ).astype(y.dtype)
-            mlp_out = mm(act * _mm_k(y, lp["w_up"], kernel_mesh), lp["w_down"])
-        out = (kp, vp, ksc, vsc) if kv_int8 else (kp, vp)
+            mlp_out = _mlp_dense(cfg, y, lp, kernel_mesh)
         return x + mlp_out, out
 
     if kv_int8:
@@ -587,7 +666,7 @@ def forward_paged_block(
         x, (new_k, new_v) = jax.lax.scan(body, x, xs)
         new_ks = new_vs = None
 
-    x = _norm(x, params["final_norm"], cfg)
+    x = _norm(x, params["final_norm"], cfg, b=params.get("final_norm_b"))
     out = _logits(x, params, cfg, kernel_mesh=kernel_mesh) if lm_head else x
     new_cache = cache._replace(
         k_pages=new_k, v_pages=new_v, lengths=cache.lengths + T,
@@ -607,7 +686,7 @@ def forward_train(
     backward pass trades FLOPs for HBM. Returns logits [B, T, V] fp32."""
     B, T = tokens.shape
     positions = jnp.tile(jnp.arange(T, dtype=jnp.int32)[None, :], (B, 1))
-    cos, sin = compute_rope_freqs(cfg.head_dim_, T, cfg.rope_theta)
+    cos, sin = compute_rope_freqs(cfg.rope_dim_, T, cfg.rope_theta)
     kv_length = jnp.zeros((B,), dtype=jnp.int32)
 
     dtype = model_dtype(params)
@@ -621,5 +700,5 @@ def forward_train(
         body = jax.checkpoint(body)
     x, _ = jax.lax.scan(body, x, params["layers"])
 
-    x = _norm(x, params["final_norm"], cfg)
+    x = _norm(x, params["final_norm"], cfg, b=params.get("final_norm_b"))
     return _logits(x, params, cfg)

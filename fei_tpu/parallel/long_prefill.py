@@ -28,11 +28,12 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from fei_tpu.models.configs import ModelConfig
 from fei_tpu.models.llama import (
-    KVCache, _logits, _mlp_act, _norm, embed_tokens, model_dtype, qkv_proj,
+    KVCache, _logits, _mlp_dense, _norm, _rope, embed_tokens, model_dtype,
+    qkv_proj,
 )
 from fei_tpu.ops.moe import moe_mlp
 from fei_tpu.ops.quant import mm
-from fei_tpu.ops.rope import apply_rope, compute_rope_freqs
+from fei_tpu.ops.rope import compute_rope_freqs
 from fei_tpu.parallel.ring import _ring_attention_shard, _ulysses_shard
 
 
@@ -55,10 +56,10 @@ def _prefill_shard(
     positions = jnp.tile(positions, (B, 1))
 
     def body(x, lp):
-        y = _norm(x, lp["attn_norm"], cfg)
+        y = _norm(x, lp["attn_norm"], cfg, b=lp.get("attn_norm_b"))
         q, k, v = qkv_proj(lp, y, Hq, K, d)
-        q = apply_rope(q, cos, sin, positions)
-        k = apply_rope(k, cos, sin, positions)
+        q = _rope(q, cos, sin, positions, cfg.rope_dim_)
+        k = _rope(k, cos, sin, positions, cfg.rope_dim_)
 
         # sliding-window configs (Mistral/Qwen2 family) mask inside the
         # sharded attends too — a long SWA prompt keeps ring prefill
@@ -75,19 +76,25 @@ def _prefill_shard(
         o = mm(attn.reshape(B, C, Hq * d), lp["wo"])
         if "bo" in lp:
             o = o + lp["bo"]
+
+        if cfg.parallel_block:  # Phi: x + attn(ln x) + mlp(ln x)
+            mlp_out = (
+                moe_mlp(
+                    y, lp["router"], lp["w_gate"], lp["w_up"], lp["w_down"],
+                    cfg.num_experts_per_tok,
+                ) if cfg.is_moe else _mlp_dense(cfg, y, lp)
+            )
+            return x + o + mlp_out, (k, v)
         x = x + o
 
-        y = _norm(x, lp["mlp_norm"], cfg)
+        y = _norm(x, lp["mlp_norm"], cfg, b=lp.get("mlp_norm_b"))
         if cfg.is_moe:
             mlp_out = moe_mlp(
                 y, lp["router"], lp["w_gate"], lp["w_up"], lp["w_down"],
                 cfg.num_experts_per_tok,
             )
         else:
-            act = _mlp_act(
-                cfg, mm(y, lp["w_gate"]).astype(jnp.float32)
-            ).astype(y.dtype)
-            mlp_out = mm(act * mm(y, lp["w_up"]), lp["w_down"])
+            mlp_out = _mlp_dense(cfg, y, lp)
         return x + mlp_out, (k, v)
 
     x, (ks, vs) = jax.lax.scan(body, x, layers)
@@ -123,7 +130,7 @@ def prefill_ring_kv(
         )
 
     dtype = model_dtype(params)
-    cos, sin = compute_rope_freqs(cfg.head_dim_, T, cfg.rope_theta)
+    cos, sin = compute_rope_freqs(cfg.rope_dim_, T, cfg.rope_theta)
     x = embed_tokens(params, cfg, tokens, dtype)  # [B, T, H] (seq-sharded in)
 
     fn = jax.shard_map(
@@ -148,7 +155,7 @@ def prefill_ring_kv(
         last = jnp.take_along_axis(
             x, jnp.broadcast_to(idx, (B, 1, x.shape[-1])), axis=1
         )[:, 0, :]
-    last = _norm(last, params["final_norm"], cfg)
+    last = _norm(last, params["final_norm"], cfg, b=params.get("final_norm_b"))
     # kernel_mesh: on an sp+tp mesh a QTensor4 lm_head must route through
     # the shard_map'd kernel (_mm_k checks for a real tp axis; sp-only
     # meshes fall through to the local path)

@@ -111,7 +111,7 @@ def pipeline_forward_train(
     mb = B // num_micro
 
     positions = jnp.tile(jnp.arange(T, dtype=jnp.int32)[None, :], (mb, 1))
-    cos, sin = compute_rope_freqs(cfg.head_dim_, T, cfg.rope_theta)
+    cos, sin = compute_rope_freqs(cfg.rope_dim_, T, cfg.rope_theta)
 
     dtype = model_dtype(params)
     x = embed_tokens(params, cfg, tokens, dtype)  # [B, T, H]
@@ -127,5 +127,5 @@ def pipeline_forward_train(
     ys = fn(params["layers"], xs, positions, cos, sin)
     x = ys.reshape(B, T, -1)
 
-    x = _norm(x, params["final_norm"], cfg)
+    x = _norm(x, params["final_norm"], cfg, b=params.get("final_norm_b"))
     return _logits(x, params, cfg)

@@ -34,6 +34,14 @@ def param_specs(
         "wv": P(None, None, "tp"),
         "wo": P(None, "tp", None),
         "mlp_norm": P(),
+        # Phi-family leaves (harmless extras for other models — the
+        # matcher only reads specs for keys the param tree actually has):
+        # LayerNorm biases replicate; fc1's bias follows its column split;
+        # fc2's bias adds once to the psummed row-parallel output
+        "attn_norm_b": P(),
+        "mlp_norm_b": P(),
+        "b_gate": P(None, "tp"),
+        "b_down": P(),
     }
     if attn_bias:
         # qkv biases follow their projection's column (head-dim) split
@@ -59,7 +67,9 @@ def param_specs(
         "embed": P("tp", None),
         "layers": layers,
         "final_norm": P(),
+        "final_norm_b": P(),
         "lm_head": P(None, "tp"),
+        "lm_head_b": P("tp"),  # follows the head's vocab split
     }
 
 

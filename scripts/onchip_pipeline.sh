@@ -106,6 +106,12 @@ stage bench_prefill env FEI_TPU_BENCH_SUITE=prefill \
   FEI_TPU_BENCH_MODEL=llama3-8b FEI_TPU_BENCH_QUANT=int8 \
   FEI_TPU_BENCH_MAX_WAIT_S=300 python -u bench.py
 
+# 5b. phi-2 decode (round 4): the ONE perf number in the reference's docs
+# is a MOCKED "Phi-2 at 67 tokens/s" (HOW_FEI_NETWORK_WORKS.md:60-75);
+# 2.7B bf16 = 5.6 GB fits the chip — measure the real thing
+stage bench_phi2 env FEI_TPU_BENCH_MODEL=phi-2 FEI_TPU_BENCH_QUANT= \
+  FEI_TPU_BENCH_MAX_WAIT_S=300 python -u bench.py
+
 # ---- TIER 2: effect-size A/Bs for the dispatch-amortization features
 # (VERDICT r3 #6) — 1B so each run is fast; the variable is the flag. ----
 
