@@ -75,6 +75,13 @@ def _make_engine(model: str, **kwargs):
     return engine
 
 
+def _tag(model: str) -> str:
+    """Metric-name prefix: model plus the quant mode when one is active —
+    ONE spelling so variant runs can never collide in onchip_state.json."""
+    quant = os.environ.get("FEI_TPU_BENCH_QUANT")
+    return f"{model}-{quant}" if quant else model
+
+
 def _prompt(engine):
     text = os.environ.get(
         "FEI_TPU_BENCH_PROMPT",
@@ -326,9 +333,7 @@ def bench_decode(model: str, n_tokens: int) -> int:
     mfu = tok_s * flops_per_tok / 197e12
     log(f"bench: est. MFU {mfu*100:.2f}% "
         f"({flops_per_tok/1e9:.1f} GFLOPs/token @ 197 TFLOP/s bf16 peak)")
-    quant = os.environ.get("FEI_TPU_BENCH_QUANT")
-    tag = f"{model}-{quant}" if quant else model
-    return _emit(f"{tag}_decode_tok_s_per_chip", tok_s,
+    return _emit(f"{_tag(model)}_decode_tok_s_per_chip", tok_s,
                  extra={"ttft_ms": round(ttft_p50 * 1000, 1)})
 
 
@@ -375,9 +380,7 @@ def bench_prefill(model: str, n_tokens: int) -> int:
     p50 = sorted(ttfts)[len(ttfts) // 2]
     log(f"bench: p50 prefill ttft={p50*1000:.1f}ms for {plen} tokens")
     engine.close()
-    quant = os.environ.get("FEI_TPU_BENCH_QUANT")
-    tag = f"{model}-{quant}" if quant else model
-    return _emit(f"{tag}_prefill{plen}_tok_s_per_chip", plen / p50,
+    return _emit(f"{_tag(model)}_prefill{plen}_tok_s_per_chip", plen / p50,
                  extra={"ttft_ms": round(p50 * 1000, 1)})
 
 
@@ -469,9 +472,8 @@ def bench_paged(model: str, n_tokens: int) -> int:
         log(f"bench: paged run {run}: {sum(counts)} tokens in {dt:.1f}s "
             f"-> {agg:.1f} tok/s aggregate")
         best = max(best, agg)
-    quant = os.environ.get("FEI_TPU_BENCH_QUANT")
     kv = os.environ.get("FEI_TPU_BENCH_KV_QUANT")
-    tag = f"{model}-{quant}" if quant else model
+    tag = _tag(model)
     if kv:
         tag += f"-kv{kv}"
     ms = os.environ.get("FEI_TPU_SCHED_MULTISTEP")
@@ -649,6 +651,7 @@ def bench_agent(model: str, n_tokens: int) -> int:
             assistant = Assistant(
                 provider=provider, tool_registry=registry, max_tokens=n_tokens
             )
+            provider.last_ttft_s = None  # record THIS turn's first round
             t0 = time.time()
             asyncio.run(assistant.chat(message))
             dt = time.time() - t0
@@ -687,9 +690,7 @@ def bench_agent(model: str, n_tokens: int) -> int:
         log(f"bench: agent p50 ttft={p50*1000:.1f}ms (first visible token "
             "through template+provider+engine)")
         extra = {"ttft_ms": round(p50 * 1000, 1)}
-    quant = os.environ.get("FEI_TPU_BENCH_QUANT")
-    tag = f"{model}-{quant}" if quant else model
-    return _emit(f"{tag}_agent_e2e_tok_s_per_chip", best, extra=extra)
+    return _emit(f"{_tag(model)}_agent_e2e_tok_s_per_chip", best, extra=extra)
 
 
 def main() -> int:

@@ -12,8 +12,9 @@ python bench.py` measures the real number (2.7B bf16 = 5.6 GB: one v5e).
 
 Run hermetically on CPU (tiny-phi preset, random weights):
   JAX_PLATFORMS=cpu python examples/phi2_node_serving.py
-With real weights:
-  FEI_TPU_JAX_LOCAL_CHECKPOINT_DIR=/path/to/phi-2 (HF safetensors layout)
+With real weights (HF safetensors layout):
+  FEI_TPU_PHI_MODEL=phi-2 FEI_TPU_PHI_CHECKPOINT=/path/to/phi-2 \
+      python examples/phi2_node_serving.py
 """
 
 import concurrent.futures as cf
@@ -29,10 +30,13 @@ from fei_tpu.engine import GenerationConfig, InferenceEngine
 
 def main() -> None:
     model = os.environ.get("FEI_TPU_PHI_MODEL", "tiny-phi")
+    ckpt = os.environ.get("FEI_TPU_PHI_CHECKPOINT") or None
     eng = InferenceEngine.from_config(
-        model, tokenizer="byte", max_seq_len=256, paged=True,
-        batch_size=2, page_size=16,
+        model, tokenizer=ckpt or "byte", checkpoint_dir=ckpt,
+        max_seq_len=256, paged=True, batch_size=2, page_size=16,
     )
+    if ckpt is None:
+        print("(random weights — set FEI_TPU_PHI_CHECKPOINT for real ones)")
     cfg = eng.cfg
     print(
         f"{cfg.name}: {cfg.num_layers} layers, parallel_block="

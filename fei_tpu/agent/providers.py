@@ -366,10 +366,13 @@ class JaxLocalProvider(Provider):
         t_start = time.perf_counter()
         with METRICS.span("provider.jax_local"):
             for tok in stream_fn(ids, gen):
-                if not out_ids:
+                if not out_ids and self.last_ttft_s is None:
                     # agent-level TTFT: prefill + first decode step, measured
                     # at the provider boundary (the BASELINE metric is TTFT
-                    # for `fei --message`, not raw engine TTFT)
+                    # for `fei --message`, not raw engine TTFT). Only the
+                    # FIRST round of a turn records — callers reset to None
+                    # per turn (bench_agent), so multi-tool-round turns
+                    # report first-token latency, not the last re-prefill.
                     self.last_ttft_s = time.perf_counter() - t_start
                 out_ids.append(tok)
                 pending.append(tok)

@@ -731,8 +731,14 @@ def _merge_hf_config(ckpt_dir: str, cfg: ModelConfig) -> ModelConfig:
             lm_head_bias=True,
             hidden_act="gelu",
             rms_norm_eps=hf.get("layer_norm_eps"),
+            # an ABSENT key means PhiConfig's class default (0.5 — configs
+            # serialized via to_diff_dict drop defaults); phi-2's real 0.4
+            # is non-default so its config.json always carries it
             rotary_dim=int(
-                (hf.get("partial_rotary_factor") or 0.5) * head_dim
+                (
+                    0.5 if hf.get("partial_rotary_factor") is None
+                    else hf["partial_rotary_factor"]
+                ) * head_dim
             ),
             # PhiConfig has no num_key_value_heads by default (MHA)
             num_kv_heads=hf.get("num_key_value_heads") or n_heads,
@@ -767,12 +773,15 @@ def _merge_hf_config(ckpt_dir: str, cfg: ModelConfig) -> ModelConfig:
         # partial per-layer windowing is rejected loudly rather than
         # silently mis-windowing every layer.
         n_layers = hf.get("num_hidden_layers", 0) or 0
-        # HF Qwen2Config defaults max_window_layers to num_hidden_layers
-        # (SWA on zero layers) — an ABSENT key must inherit that default,
-        # not 0, or the config would silently window every layer. An
-        # explicit 0 remains the all-layers opt-in.
+        # An ABSENT max_window_layers inherits HF Qwen2Config's class
+        # default of 28 (verified against the installed transformers:
+        # layers >= max_window_layers slide, the rest are full-attention).
+        # For <= 28 layers that means zero sliding layers (no window); for
+        # deeper configs it means PARTIAL windowing, which the uniform
+        # decoder rejects loudly below rather than silently mis-windowing.
+        # An explicit 0 remains the all-layers opt-in.
         mwl = hf.get("max_window_layers")
-        mwl = n_layers if mwl is None else mwl
+        mwl = 28 if mwl is None else mwl
         if mwl >= n_layers:
             fields["sliding_window"] = None
         elif mwl == 0:

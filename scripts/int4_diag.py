@@ -13,6 +13,14 @@ Two modes:
   and print XLA's memory_analysis — catches structural blowups (e.g. a
   full bf16 dequant materialized program-wide) without a chip.
 
+  Round-4 hermetic result: CPU temp numbers are NOT representative of TPU
+  buffer assignment — int8 init measures 147 GB of CPU temps yet ran in
+  21.1 s on the 16 GB chip (r3), while int4 init measures 13.7 GB; the
+  int4 forward (2.89 GB CPU temps) is comparable to the proven int8 one
+  (3.60 GB). Nothing int4-specific shows hermetically, so the on-chip
+  layer ladder below (with per-step device memory_stats) is the
+  authoritative diagnostic.
+
 Never killed from outside: a client killed mid-TPU-claim wedges the lease.
 """
 from __future__ import annotations

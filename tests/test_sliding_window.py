@@ -303,13 +303,26 @@ class TestHFWindowMerge:
 
     def test_qwen2_absent_mwl_inherits_hf_default(self, tmp_path):
         """A config.json that relies on HF Qwen2Config's max_window_layers
-        default (== num_hidden_layers) must get the SAME semantics as an
-        explicit value: no window — NOT all-layers windowing (ADVICE r3)."""
+        default (28) must get the SAME semantics as an explicit 28: with
+        <= 28 layers, zero sliding layers — NOT all-layers windowing
+        (ADVICE r3, corrected to the real HF default in r4)."""
         cfg = self._merge(tmp_path, {
             "model_type": "qwen2", "use_sliding_window": True,
             "sliding_window": 128, "num_hidden_layers": 4,
         })
         assert cfg.sliding_window is None
+
+    def test_qwen2_absent_mwl_deep_config_rejected(self, tmp_path):
+        """Deeper than 28 layers with the key absent = HF windows layers
+        28..n-1 — partial windowing the uniform decoder cannot represent:
+        must fail loudly, not silently load full-causal."""
+        from fei_tpu.utils.errors import CheckpointError
+
+        with pytest.raises(CheckpointError, match="max_window_layers"):
+            self._merge(tmp_path, {
+                "model_type": "qwen2", "use_sliding_window": True,
+                "sliding_window": 128, "num_hidden_layers": 48,
+            })
 
     def test_qwen2_explicit_zero_windows_all_layers(self, tmp_path):
         cfg = self._merge(tmp_path, {
