@@ -119,6 +119,7 @@ class TestInt8WritePath:
         assert np.all(np.abs(back - np.asarray(k_new[0])) <= amax / 254 + 1e-7)
 
 
+@pytest.mark.slow  # fast lane: -m 'not slow'
 class TestInt8Serving:
     def test_scheduler_int8_kv(self):
         from fei_tpu.engine import GenerationConfig, InferenceEngine

@@ -126,6 +126,7 @@ def test_forward_train_matches_cached_forward():
     )
 
 
+@pytest.mark.slow  # fast lane: -m 'not slow' (the driver runs this anyway)
 def test_graft_entry_dryrun():
     import __graft_entry__ as g
 
@@ -139,6 +140,7 @@ def test_graft_entry_compiles():
     jax.jit(fn).lower(*args)  # lowering catches shape/sharding errors
 
 
+@pytest.mark.slow  # fast lane: -m 'not slow'
 def test_single_prompt_generation_on_dp_mesh():
     """Batch-1 generation must work on a mesh with dp > 1 (cache batch dim
     replicates instead of trying to split 1 over dp)."""
