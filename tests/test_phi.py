@@ -46,6 +46,34 @@ class TestTinyPhiServing:
         res = eng.generate(eng.tokenizer.encode("int8 phi"), GEN)
         assert len(res.token_ids) == GEN.max_new_tokens
 
+    @pytest.mark.slow  # fast lane: -m 'not slow'
+    def test_sp_prefill_matches_dense(self):
+        """The parallel block runs inside the ring-prefill shard body too:
+        a long tiny-phi prompt over the sp mesh must route sp and be
+        token-identical to the dense engine."""
+        import jax
+
+        from fei_tpu.parallel.mesh import make_mesh
+        from fei_tpu.utils.metrics import METRICS
+
+        prompt = [(7 * i + 11) % 200 + 10 for i in range(1024)]
+        gen = GenerationConfig(max_new_tokens=8, temperature=0.0,
+                               ignore_eos=True)
+        dense = InferenceEngine.from_config("tiny-phi", max_seq_len=2048)
+        want = dense.generate(prompt, gen).token_ids
+
+        n = min(8, len(jax.devices()))
+        mesh = make_mesh({"sp": n}, devices=jax.devices()[:n])
+        sp = InferenceEngine.from_config(
+            "tiny-phi", max_seq_len=2048, mesh=mesh, long_prefill_min=512
+        )
+        before = METRICS.snapshot()["counters"].get("engine.sp_prefills", 0)
+        got = sp.generate(prompt, gen).token_ids
+        assert METRICS.snapshot()["counters"].get(
+            "engine.sp_prefills", 0
+        ) > before, "phi prompt did not sp-prefill"
+        assert got == want, (got, want)
+
 
 transformers = pytest.importorskip("transformers")
 torch = pytest.importorskip("torch")
