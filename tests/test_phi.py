@@ -75,6 +75,32 @@ class TestTinyPhiServing:
         assert got == want, (got, want)
 
 
+class TestTinyPhiParallelism:
+    def test_pipeline_forward_matches_dense(self):
+        """The parallel block through the pp pipeline (GPipe stages call
+        the same _layer body)."""
+        import jax
+        import numpy as np_
+
+        from fei_tpu.models.configs import get_model_config as gmc
+        from fei_tpu.models.llama import forward_train, init_params
+        from fei_tpu.parallel.mesh import make_mesh
+        from fei_tpu.parallel.pipeline import pipeline_forward_train
+
+        n = 4 if len(jax.devices()) >= 4 else len(jax.devices())
+        mesh = make_mesh({"pp": n}, devices=jax.devices()[:n])
+        cfg = gmc("tiny-phi", num_layers=2 * n)
+        params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(1), (4, 16), 0, cfg.vocab_size
+        )
+        want = forward_train(params, cfg, tokens, remat=False)
+        got = pipeline_forward_train(params, cfg, tokens, mesh, num_micro=2)
+        np_.testing.assert_allclose(
+            np_.asarray(got), np_.asarray(want), atol=1e-3
+        )
+
+
 transformers = pytest.importorskip("transformers")
 torch = pytest.importorskip("torch")
 
