@@ -487,16 +487,37 @@ def _bwd_impl(
         interpret=interpret,
     )(*args)
 
-    # dk/dv per query head (grid swaps: k blocks outer, q blocks inner)
-    q_spec_i = pl.BlockSpec(
-        (1, 1, block_q, D), lambda b, h, j, i, *_: (b, h, i, 0)
-    )
+    # dk/dv per query head (grid swaps: k blocks outer, q blocks inner).
+    # Mirror of _kv_index_map for the swapped grid: q tiles entirely above
+    # the diagonal (no query of the tile sees k tile j) or entirely past
+    # the window's reach clamp to the nearest live tile, so dead q/dO/row
+    # blocks reuse the previous copy instead of streaming from HBM.
+    def _q_idx(head_axis):
+        def idx(b, h, j, i, q_start, kv_len):
+            k_first = j * block_k
+            # first live q tile: its LAST query reaches k_first causally
+            lo = jnp.maximum(
+                -(-(k_first - q_start[b] - block_q + 1) // block_q), 0
+            )
+            if window:
+                # last live q tile: its FIRST query's window still reaches
+                # the k tile's last position (q - window < (j+1)*bk - 1)
+                hi = jnp.maximum(
+                    ((j + 1) * block_k - 2 + window - q_start[b]) // block_q,
+                    lo,
+                )
+                ii = jnp.clip(i, lo, hi)
+            else:
+                ii = jnp.maximum(i, lo)
+            return (b, head_axis(h), ii, 0)
+
+        return idx
+
+    q_spec_i = pl.BlockSpec((1, 1, block_q, D), _q_idx(lambda h: h))
     kv_spec_i = pl.BlockSpec(
         (1, 1, block_k, D), lambda b, h, j, i, *_: (b, h // groups, j, 0)
     )
-    row_spec_i = pl.BlockSpec(
-        (1, 1, block_q, _LANES), lambda b, h, j, i, *_: (b, h, i, 0)
-    )
+    row_spec_i = pl.BlockSpec((1, 1, block_q, _LANES), _q_idx(lambda h: h))
     dkv_out_spec = pl.BlockSpec(
         (1, 1, block_k, D), lambda b, h, j, i, *_: (b, h, j, 0)
     )
