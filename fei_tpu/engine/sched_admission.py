@@ -19,6 +19,7 @@ import numpy as np
 
 from fei_tpu.engine.sampling import sample_logits
 from fei_tpu.models.llama import KVCache, forward
+from fei_tpu.utils.errors import EngineError
 from fei_tpu.utils.logging import get_logger
 from fei_tpu.utils.metrics import METRICS
 

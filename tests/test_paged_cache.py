@@ -422,6 +422,69 @@ class TestPrefixCache:
         assert got1 == want1
         assert got2 == want2
 
+    def test_stale_memoized_prefix_reprobes(self):
+        """A memoized prefix match whose pages died behind the memo must be
+        re-probed at admission, not kill the sequence (the take_ref pin at
+        sched_admission.py's defensive except path — regression test for the
+        round-4 mixin split dropping the EngineError import, which turned the
+        recovery handler itself into a NameError)."""
+        gen = GenerationConfig(max_new_tokens=3, temperature=0.0, ignore_eos=True)
+        eng = self._engine()
+        sched = eng.scheduler
+        sched._ensure_pool()
+        from fei_tpu.engine.scheduler import _Seq
+
+        prompt = eng.tokenizer.encode("stale prefix recovery", add_bos=True)
+        seq = _Seq(
+            prompt_ids=list(prompt), gen=gen, mask_fn=None,
+            stops=eng._stops(gen), budget=3,
+        )
+        # a dead page: never alloc'd, refcount 0 — take_ref must raise
+        # EngineError and the handler must re-probe instead of raising
+        seq.prefix_match = [3]
+        with pytest.raises(EngineError):
+            eng._allocator.take_ref([3])
+        sched._waiting.append(seq)
+        sched._admit_ready()  # drives admission on THIS thread, no loop
+        assert not seq.finished
+        assert seq.slot >= 0
+        first = seq.out.get_nowait()
+        assert isinstance(first, int)
+        # the stale memo was replaced by a fresh probe result
+        assert seq.prefix_match != [3]
+
+    def test_stale_memo_reprobe_finds_live_entry(self):
+        """Same recovery path, but the fresh probe HITS: a live registry
+        entry for the same prompt must be pinned and shared after the stale
+        memo is discarded."""
+        gen = GenerationConfig(max_new_tokens=3, temperature=0.0, ignore_eos=True)
+        eng = self._engine()
+        sched = eng.scheduler
+        sched._ensure_pool()
+        from fei_tpu.engine.scheduler import _Seq
+
+        alloc = eng._allocator
+        reg = sched._prefix
+        prompt = eng.tokenizer.encode("x" * 40, add_bos=True)  # >2 pages of 16
+        pages = alloc.alloc(99, 2)
+        reg.register(prompt, pages)
+        alloc.free(99)  # registry refs keep the pages alive
+        live = reg.match(prompt)
+        assert live == pages[:2]
+
+        seq = _Seq(
+            prompt_ids=list(prompt), gen=gen, mask_fn=None,
+            stops=eng._stops(gen), budget=3,
+        )
+        dead = [p for p in range(1, alloc.num_pages) if p not in alloc._refs][0]
+        seq.prefix_match = [dead]
+        sched._waiting.append(seq)
+        sched._admit_ready()
+        assert not seq.finished
+        assert seq.prefix_match == live
+        # shared pages: registry ref + this sequence's ref
+        assert all(alloc._refs[p] >= 2 for p in live)
+
     def test_eviction_under_pool_pressure(self):
         """A full registry yields its pages back when a new admission
         needs them."""
