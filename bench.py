@@ -2,7 +2,14 @@
 BASELINE.json ("tokens/sec/chip + p50 TTFT for fei --message").
 
 Prints exactly ONE JSON line to stdout:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
+plus suite-dependent extras: "ttft_ms"; for decode, the roofline fields
+"gb_per_tok" / "achieved_gbps" / "pct_v5e_hbm" / "roofline_tok_s". When the
+TPU is unavailable and a persisted gate measurement exists, a decode-suite
+line reports THAT record as the headline with "stale": true and
+"source": "onchip_state <ts>", demoting the CPU run to "cpu_liveness";
+other suites (and a state-less checkout) keep an explicit
+*_CPU_FALLBACK_TPU_UNAVAILABLE metric instead.
 
 vs_baseline is value / 20.0 — the BASELINE.json north-star floor of
 20 tok/s/chip (the reference publishes no numbers of its own; BASELINE.md).
@@ -108,14 +115,13 @@ def _load_state() -> dict:
         return {}
 
 
-def _record_onchip(line: dict, extra: dict | None) -> None:
+def _record_onchip(line: dict) -> None:
     """Persist a REAL on-chip measurement so later outages can still report
     it (VERDICT r3 #1: the chip comes and goes; the driver snapshot must not
     depend on the backend being up at that instant). Only called for
-    measurements taken on an actual TPU backend."""
+    measurements taken on an actual TPU backend. ``line`` already carries
+    the suite's extras (_emit merges them before recording)."""
     entry = dict(line)
-    if extra:
-        entry.update(extra)
     entry["ts"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
     try:
         import jax
@@ -125,14 +131,27 @@ def _record_onchip(line: dict, extra: dict | None) -> None:
         pass
     state = _load_state()
     state.setdefault("suites", {})[line["metric"]] = entry
-    # the headline slot tracks the BASELINE config #2 gate metric; any other
-    # suite only lands there if no gate result exists yet
-    if line["metric"] == GATE_METRIC or not state.get("last_onchip"):
+    # the headline slot holds ONLY the BASELINE config #2 gate metric — a
+    # first-recorded int4/paged/A-B stage must never occupy it, or an outage
+    # would carry a non-gate number as the headline (round-4 advisory)
+    if line["metric"] == GATE_METRIC:
         state["last_onchip"] = entry
     tmp = STATE_PATH + ".tmp"
     with open(tmp, "w") as f:
         json.dump(state, f, indent=1, sort_keys=True)
     os.replace(tmp, STATE_PATH)  # atomic: a mid-write kill can't truncate
+
+
+def _gate_record(state: dict) -> dict | None:
+    """The persisted BASELINE config #2 gate measurement, if one exists.
+    Reads the dedicated suites slot first; a legacy state file whose
+    last_onchip happens to BE the gate metric also counts."""
+    gate = state.get("suites", {}).get(GATE_METRIC)
+    if gate is None:
+        last = state.get("last_onchip")
+        if last and last.get("metric") == GATE_METRIC:
+            gate = last
+    return gate
 
 
 def _emit(metric: str, value: float, unit: str = "tok/s/chip",
@@ -143,16 +162,41 @@ def _emit(metric: str, value: float, unit: str = "tok/s/chip",
         "unit": unit,
         "vs_baseline": round(value / 20.0, 3),
     }
+    if extra:
+        line.update(extra)
     if os.environ.get("FEI_TPU_BENCH_CPU_FALLBACK"):
-        # never let a CPU liveness number masquerade as a TPU measurement —
-        # but DO carry the last real on-chip result as structured metadata
-        # so the driver artifact records it even through an outage
-        line["metric"] = f"{metric}_CPU_FALLBACK_TPU_UNAVAILABLE"
-        last = _load_state().get("last_onchip")
-        if last:
-            line["last_onchip"] = last
+        # TPU-roofline extras are meaningless for a CPU liveness run —
+        # never print a pct_v5e_hbm for a run that touched no TPU
+        for k in ("gb_per_tok", "achieved_gbps", "pct_v5e_hbm",
+                  "roofline_tok_s"):
+            line.pop(k, None)
+        # a DECODE-suite fallback reports the last REAL gate measurement as
+        # the headline (clearly marked stale), never the meaningless
+        # tiny-CPU number — a driver reading parsed.value gets a TPU number
+        # in both the live and the outage case (round-4 verdict #4). The
+        # CPU run is demoted to liveness metadata: it proves the stack
+        # still executes. Other suites keep their own (labeled) metric so
+        # a mid-pipeline outage cannot masquerade a decode number as a
+        # prefill/paged/agent result.
+        gate = _gate_record(_load_state())
+        if gate and metric.endswith("_decode_tok_s_per_chip"):
+            line = dict(gate)
+            line["source"] = f"onchip_state {gate.get('ts', 'unknown')}"
+            line["stale"] = True
+            line["cpu_liveness"] = {
+                "metric": f"{metric}_CPU_FALLBACK",
+                "value": round(value, 2),
+                "unit": unit,
+            }
+        else:
+            # non-decode suite, or no gate record anywhere: label the CPU
+            # number honestly; still carry the gate record as metadata so
+            # the artifact keeps the on-chip evidence through the outage
+            line["metric"] = f"{metric}_CPU_FALLBACK_TPU_UNAVAILABLE"
+            if gate:
+                line["last_onchip"] = gate
     elif os.environ.get("FEI_TPU_BENCH_ONCHIP"):
-        _record_onchip(line, extra)
+        _record_onchip(line)
     print(json.dumps(line), flush=True)
     return 0
 
@@ -283,6 +327,47 @@ def _touch_backend_or_reexec():
         os.execv(sys.executable, [sys.executable] + sys.argv)
 
 
+# v5e HBM bandwidth (chip spec ~819 GB/s). Single-stream decode is
+# weight-streaming-bound, so tok/s × bytes-streamed-per-token against this
+# ceiling — not MFU — is the lens that says whether there is headroom.
+V5E_HBM_GBPS = 819.0
+
+
+def _decode_stream_bytes(engine, mean_ctx: int) -> dict:
+    """HBM bytes streamed to decode ONE token (the roofline basis,
+    round-4 verdict #5): every weight byte except the embedding table
+    (a gather reads ~one row; tied embeddings ARE the lm_head and stream
+    fully), MoE expert bytes scaled to the top-k actually routed, plus the
+    K/V cache read at the mean decode context and the new token's K/V
+    write. Activations/norm traffic is O(hidden) per layer — noise next to
+    the weight stream — and is reported inside `other` by omission."""
+    from fei_tpu.ops.quant import param_bytes
+
+    cfg = engine.cfg
+    p = engine.params
+    weights = param_bytes(p)
+    if not cfg.tie_embeddings and "embed" in p:
+        weights -= param_bytes(p["embed"])
+    if cfg.is_moe:
+        k, E = cfg.num_experts_per_tok, cfg.num_experts
+        layers = p.get("layers", {})
+        for name in ("w_gate", "w_up", "w_down"):
+            if name in layers:
+                weights -= param_bytes(layers[name]) * (1 - k / E)
+    import jax.numpy as jnp
+
+    itemsize = jnp.dtype(engine.dtype).itemsize
+    kv_row = 2 * cfg.num_layers * cfg.num_kv_heads * cfg.head_dim_ * itemsize
+    kv_read = kv_row * mean_ctx
+    kv_write = kv_row
+    return {
+        "weights": int(weights),
+        "kv_read": int(kv_read),
+        "kv_write": int(kv_write),
+        "total": int(weights + kv_read + kv_write),
+    }
+
+
 def bench_decode(model: str, n_tokens: int) -> int:
     from fei_tpu.engine import GenerationConfig
 
@@ -325,16 +410,42 @@ def bench_decode(model: str, n_tokens: int) -> int:
     ttft_p50 = sorted(ttfts)[len(ttfts) // 2]
     tok_s = sorted(tps)[len(tps) // 2]
     log(f"bench: p50 ttft={ttft_p50*1000:.1f}ms")
-    # MFU estimate: ~2 FLOPs per ACTIVE weight per token (top-k experts
-    # only; embedding gather excluded) over the v5e bf16 peak (197 TFLOP/s).
-    # Single-stream decode is weight-streaming-bound, so a few percent is
-    # expected; the number contextualizes, not judges.
+    prof = os.environ.get("FEI_TPU_BENCH_PROFILE")
+    if prof:
+        # one traced generation for the roofline gap attribution (where do
+        # the GB/s between achieved and the streaming bound go) — viewable
+        # with tensorboard or xprof against the written directory
+        import jax
+
+        with jax.profiler.trace(prof):
+            engine.generate_fused(prompt, gen, chunk=64)
+        log(f"bench: profiler trace written to {prof}")
+    # Roofline: decode is weight-streaming-bound, so the honest utilization
+    # lens is tok/s × bytes-streamed-per-token against the HBM ceiling.
+    # (MFU stays as a secondary stderr line: a few percent is EXPECTED for
+    # single-stream decode — it contextualizes, it does not judge.)
+    mean_ctx = len(prompt) + n_tokens // 2
+    sb = _decode_stream_bytes(engine, mean_ctx)
+    eff_bw = tok_s * sb["total"]
+    pct = 100.0 * eff_bw / (V5E_HBM_GBPS * 1e9)
+    ceiling = V5E_HBM_GBPS * 1e9 / sb["total"]
+    log(f"bench: roofline {sb['total']/1e9:.2f} GB/token "
+        f"(weights {sb['weights']/1e9:.2f} + kv_read {sb['kv_read']/1e9:.3f} "
+        f"+ kv_write {sb['kv_write']/1e6:.1f}e-3) -> {eff_bw/1e9:.0f} GB/s "
+        f"achieved = {pct:.0f}% of v5e {V5E_HBM_GBPS:.0f} GB/s; "
+        f"streaming-bound ceiling {ceiling:.1f} tok/s")
     flops_per_tok = 2.0 * engine.cfg.num_active_params()
     mfu = tok_s * flops_per_tok / 197e12
     log(f"bench: est. MFU {mfu*100:.2f}% "
         f"({flops_per_tok/1e9:.1f} GFLOPs/token @ 197 TFLOP/s bf16 peak)")
     return _emit(f"{_tag(model)}_decode_tok_s_per_chip", tok_s,
-                 extra={"ttft_ms": round(ttft_p50 * 1000, 1)})
+                 extra={
+                     "ttft_ms": round(ttft_p50 * 1000, 1),
+                     "gb_per_tok": round(sb["total"] / 1e9, 3),
+                     "achieved_gbps": round(eff_bw / 1e9, 1),
+                     "pct_v5e_hbm": round(pct, 1),
+                     "roofline_tok_s": round(ceiling, 1),
+                 })
 
 
 def bench_prefill(model: str, n_tokens: int) -> int:
