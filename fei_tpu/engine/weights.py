@@ -286,11 +286,29 @@ def _plans(reader: _ShardReader, cfg: ModelConfig) -> dict:
         plans[("layers", "mlp_norm")] = stacked(lmap["mlp_norm"], (h,), False)
     if cfg.norm_kind == "layernorm":
         # only the Phi maps carry bias names today; a non-parallel-block
-        # layernorm family (GPT-NeoX-style) would need its own map entries
-        # including a distinct mlp_norm_b
+        # layernorm family (GPT-NeoX-style) needs its own map entries
+        # including a distinct mlp_norm_b — fail as a CheckpointError up
+        # front, not a KeyError mid-plan (and never silently leave the
+        # init_params mlp_norm_b leaf unloaded)
+        need = ["attn_norm_b"] + (
+            [] if cfg.parallel_block else ["mlp_norm_b"]
+        )
+        missing = [k for k in need if k not in lmap]
+        if "final_norm_b" not in tmap:
+            missing.append("final_norm_b (top map)")
+        if missing:
+            raise CheckpointError(
+                f"layernorm family {cfg.name!r} has no weight-map entries "
+                f"for {missing}: add them to its layer and top maps before "
+                "loading"
+            )
         plans[("layers", "attn_norm_b")] = stacked(
             lmap["attn_norm_b"], (h,), False
         )
+        if not cfg.parallel_block:
+            plans[("layers", "mlp_norm_b")] = stacked(
+                lmap["mlp_norm_b"], (h,), False
+            )
         plans[("final_norm_b",)] = top("final_norm_b", (h,), False)
     if cfg.attn_bias:
         plans[("layers", "bq")] = stacked(lmap["bq"], (H * d,), False)

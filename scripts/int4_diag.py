@@ -102,10 +102,19 @@ def onchip_ladder() -> None:
     from fei_tpu.models.configs import get_model_config
     from fei_tpu.models.llama import KVCache, forward, init_params
 
+    # rehearsal knobs (scripts/rehearse_pipeline.sh): same code path, tiny
+    # scale — FEI_TPU_INT4_DIAG_MODEL=tiny FEI_TPU_INT4_DIAG_LADDER=1,2
+    # exercises the ladder end-to-end on the CPU backend so a typo here
+    # can never burn a short chip window
+    model = os.environ.get("FEI_TPU_INT4_DIAG_MODEL", "llama3-8b")
+    ladder = tuple(
+        int(x) for x in
+        os.environ.get("FEI_TPU_INT4_DIAG_LADDER", "8,16,24,32").split(",")
+    )
     say(f"attach: {jax.devices()}")
     mem_stats("attach")
-    for L in (8, 16, 24, 32):
-        cfg = get_model_config("llama3-8b", num_layers=L)
+    for L in ladder:
+        cfg = get_model_config(model, num_layers=L)
         t0 = time.time()
         try:
             params = init_params(cfg, jax.random.PRNGKey(0), quantize="int4")
@@ -150,10 +159,13 @@ def onchip_ladder() -> None:
 
 
 if __name__ == "__main__":
-    if os.environ.get("FEI_TPU_INT4_DIAG_AOT"):
-        from fei_tpu.utils.platform import honor_jax_platforms
+    # honor an explicit JAX_PLATFORMS=cpu in BOTH modes (the sitecustomize
+    # pins axon otherwise) — the on-chip pipeline leaves it unset, so the
+    # chip path is unchanged; the hermetic rehearsal sets cpu
+    from fei_tpu.utils.platform import honor_jax_platforms
 
-        honor_jax_platforms()
+    honor_jax_platforms()
+    if os.environ.get("FEI_TPU_INT4_DIAG_AOT"):
         aot_report()
     else:
         onchip_ladder()

@@ -1,0 +1,117 @@
+#!/bin/bash
+# Hermetic rehearsal of EVERY armed on-chip pipeline stage (VERDICT r4 #2:
+# several stages had never executed end-to-end anywhere; the r3 chip window
+# lasted 16 minutes — a typo in a never-run stage burns the next one).
+#
+# Each stage below runs the SAME command as scripts/onchip_pipeline.sh with
+# only scale knobs changed (model=tiny, few tokens, CPU backend). A stage
+# passes when it exits 0 AND (for bench stages) its last stdout line parses
+# as a well-formed bench JSON line. Test stages are verified to COLLECT
+# (pytest --collect-only): their assertions already run in the hermetic
+# suite; what a window cannot afford is a wrong file path or env name.
+#
+# Run:    bash scripts/rehearse_pipeline.sh        (~10-20 min on one core)
+# Output: /tmp/rehearse/<stage>.log + PASS/FAIL table on stdout; rc != 0 if
+#         any stage fails.
+set -u
+OUT="${OUT:-/tmp/rehearse}"
+mkdir -p "$OUT"
+cd /root/repo
+
+# the sitecustomize pins the axon TPU platform; every child must pin CPU
+# (bench.py / int4_diag.py honor the env var via honor_jax_platforms)
+export JAX_PLATFORMS=cpu
+export FEI_TPU_BENCH_MODEL=tiny
+export FEI_TPU_BENCH_TOKENS=8
+export FEI_TPU_BENCH_MAX_WAIT_S=30
+
+FAIL=0
+declare -a RESULTS=()
+
+check_json() {  # $1 = log file: last stdout line must be a bench JSON line
+  python - "$1" <<'EOF'
+import json, sys
+lines = [l for l in open(sys.argv[1]).read().splitlines() if l.strip()]
+d = json.loads(lines[-1])
+assert "metric" in d and "value" in d and "unit" in d, d
+print(f"  json ok: {d['metric']}={d['value']} {d['unit']}")
+EOF
+}
+
+stage() {  # stage <name> [--json] -- cmd...
+  local name="$1"; shift
+  local want_json=0
+  if [ "$1" = "--json" ]; then want_json=1; shift; fi
+  [ "$1" = "--" ] && shift
+  local t0=$SECONDS
+  echo "=== $name: $*"
+  if "$@" > "$OUT/$name.log" 2>&1; then
+    if [ "$want_json" = 1 ] && ! check_json "$OUT/$name.log"; then
+      RESULTS+=("FAIL $name (bad JSON line) $((SECONDS-t0))s"); FAIL=1
+      tail -5 "$OUT/$name.log" | sed 's/^/  | /'
+      return
+    fi
+    RESULTS+=("PASS $name $((SECONDS-t0))s")
+  else
+    RESULTS+=("FAIL $name (rc=$?) $((SECONDS-t0))s"); FAIL=1
+    tail -15 "$OUT/$name.log" | sed 's/^/  | /'
+  fi
+}
+
+# --- tier-1 stages, in the pipeline's armed order -------------------------
+
+# 1. the gate: decode suite (pipeline: llama3-8b int8 -> tiny int8 here)
+stage bench_8b_int8 --json -- env FEI_TPU_BENCH_QUANT=int8 python -u bench.py
+
+# 2. agent e2e through the whole stack (NEVER run anywhere before r5)
+stage bench_agent_8b --json -- env FEI_TPU_BENCH_SUITE=agent \
+  FEI_TPU_BENCH_QUANT=int8 python -u bench.py
+
+# 3. gate-scale paged serving: int8 weights + int8 KV, 4 then 8 streams
+stage bench_8b_paged_4s --json -- env FEI_TPU_BENCH_SUITE=paged \
+  FEI_TPU_BENCH_QUANT=int8 FEI_TPU_BENCH_KV_QUANT=int8 python -u bench.py
+stage bench_8b_paged_8s --json -- env FEI_TPU_BENCH_SUITE=paged \
+  FEI_TPU_BENCH_QUANT=int8 FEI_TPU_BENCH_KV_QUANT=int8 \
+  FEI_TPU_BENCH_STREAMS=8 python -u bench.py
+
+# 4. int4: test collection, the ladder diagnostic (same code path, tiny
+# ladder), the int4 decode bench
+stage int4_tests_collect -- python -m pytest tests/test_int4.py \
+  --collect-only -q
+stage int4_diag -- env FEI_TPU_INT4_DIAG_MODEL=tiny \
+  FEI_TPU_INT4_DIAG_LADDER=1,2 python -u scripts/int4_diag.py
+stage bench_8b_int4 --json -- env FEI_TPU_BENCH_QUANT=int4 python -u bench.py
+
+# 5. prefill TTFT (pipeline: 4096 tokens -> 192 here)
+stage bench_prefill --json -- env FEI_TPU_BENCH_SUITE=prefill \
+  FEI_TPU_BENCH_PREFILL_LEN=192 python -u bench.py
+
+# 5b. phi-2 decode (tiny-phi exercises the Phi architecture path)
+stage bench_phi2 --json -- env FEI_TPU_BENCH_MODEL=tiny-phi \
+  FEI_TPU_BENCH_QUANT= python -u bench.py
+
+# --- tier-2 A/Bs (the exact flag each arm flips) --------------------------
+stage ab_multistep_1 --json -- env FEI_TPU_BENCH_SUITE=paged \
+  FEI_TPU_SCHED_MULTISTEP=1 python -u bench.py
+stage ab_multistep_8 --json -- env FEI_TPU_BENCH_SUITE=paged \
+  FEI_TPU_SCHED_MULTISTEP=8 python -u bench.py
+stage ab_spec_off --json -- env FEI_TPU_BENCH_SUITE=paged \
+  FEI_TPU_BENCH_STREAMS=1 FEI_TPU_SPECULATE=0 python -u bench.py
+stage ab_spec_on --json -- env FEI_TPU_BENCH_SUITE=paged \
+  FEI_TPU_BENCH_STREAMS=1 FEI_TPU_SPECULATE=1 python -u bench.py
+
+# --- tier-3 re-validation stages: verify the pytest selections collect ----
+stage kernels_collect -- python -m pytest tests/test_pallas_kernels.py \
+  tests/test_kv_quant.py tests/test_sliding_window.py --collect-only -q
+stage flash_grad_collect -- python -m pytest tests/test_flash_in_model.py \
+  --collect-only -q
+stage bench_paged --json -- env FEI_TPU_BENCH_SUITE=paged python -u bench.py
+stage bench_paged_kv8 --json -- env FEI_TPU_BENCH_SUITE=paged \
+  FEI_TPU_BENCH_KV_QUANT=int8 python -u bench.py
+stage bench_moe --json -- env FEI_TPU_BENCH_SUITE=moe \
+  FEI_TPU_BENCH_MODEL=tiny-moe python -u bench.py
+
+echo
+echo "=== rehearsal results ==="
+for r in "${RESULTS[@]}"; do echo "$r"; done
+exit $FAIL

@@ -60,6 +60,7 @@ from fei_tpu.parallel.sharding import (
 )
 
 ckpt, cfg_kw = sys.argv[1], json.loads(sys.argv[2])
+quantize = sys.argv[3] or None  # "" -> fp32, else int8 / int4
 cfg = get_model_config("llama3-70b", **cfg_kw)
 report = {}
 
@@ -70,11 +71,14 @@ def maxrss():
 n = min(8, len(jax.devices()))
 tp_mesh = make_mesh({"tp": n}, devices=jax.devices()[:n])
 
-# --- streamed sharded load, clean RSS watermark
+# --- streamed sharded load, clean RSS watermark. Real 70B deploys
+# QUANTIZED (~140 GB bf16 must shed weight for KV headroom on v5e-64):
+# quantize-on-read happens slice-by-slice, so the fp32 tree is never
+# resident either
 gc.collect()
 wm0 = maxrss()
 _, params = load_checkpoint(
-    ckpt, cfg, dtype=jnp.float32,
+    ckpt, cfg, dtype=jnp.float32, quantize=quantize,
     shardings=param_shardings_from_cfg(cfg, tp_mesh),
 )
 jax.block_until_ready(params)
@@ -98,22 +102,31 @@ report["decode_finite"] = bool(np.isfinite(np.asarray(logits2)).all())
 report["decode_len"] = int(np.asarray(cache.length)[0])
 
 # --- 80 layers staged over pp with tp-sharded weights inside each stage,
-# checked against the dense forward on a short batch
-pp_mesh = make_mesh({"pp": 2, "tp": n // 2}, devices=jax.devices()[:n])
-params_pp = jax.device_put(params, param_shardings(params, pp_mesh, cfg.is_moe))
-toks = np.random.default_rng(0).integers(0, cfg.vocab_size, (2, 8)).astype(np.int32)
-want = forward_train(params, cfg, jnp.asarray(toks), remat=False)
-got = pipeline_forward_train(
-    params_pp, cfg, jnp.asarray(toks), pp_mesh, num_micro=2
-)
-np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-3)
-report["pp_matches_dense"] = True
+# checked against the dense forward on a short batch (training path:
+# fp32 only — the quantized variants rehearse the SERVING deployment,
+# which is the decode step above)
+if quantize is None:
+    pp_mesh = make_mesh({"pp": 2, "tp": n // 2}, devices=jax.devices()[:n])
+    params_pp = jax.device_put(params, param_shardings(params, pp_mesh, cfg.is_moe))
+    toks = np.random.default_rng(0).integers(0, cfg.vocab_size, (2, 8)).astype(np.int32)
+    want = forward_train(params, cfg, jnp.asarray(toks), remat=False)
+    got = pipeline_forward_train(
+        params_pp, cfg, jnp.asarray(toks), pp_mesh, num_micro=2
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-3)
+    report["pp_matches_dense"] = True
 print(json.dumps(report))
 """
 
 
 class Test70BRehearsal:
-    def test_70b_shaped_load_decode_and_pipeline(self, tmp_path):
+    @pytest.mark.parametrize("quantize", [None, "int8", "int4"])
+    def test_70b_shaped_load_decode_and_pipeline(self, tmp_path, quantize):
+        """fp32 rehearses load + decode + the pp training forward; int8 and
+        int4 rehearse 70B the way it actually DEPLOYS (VERDICT r4 #6 /
+        SURVEY hard-part #4: ~140 GB bf16 must quantize for headroom) —
+        quantize-on-read streamed load onto the tp mesh under the same RSS
+        discipline, then a sharded decode step on the packed weights."""
         cfg = get_model_config("llama3-70b", **_CFG_KW)
         assert cfg.num_layers == 80  # the REAL depth is the point
         _write_hf_llama(tmp_path, cfg)
@@ -128,24 +141,41 @@ class Test70BRehearsal:
         repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
         out = subprocess.run(
-            [sys.executable, "-c", _CHILD, str(tmp_path), json.dumps(_CFG_KW)],
+            [sys.executable, "-c", _CHILD, str(tmp_path),
+             json.dumps(_CFG_KW), quantize or ""],
             capture_output=True, text=True, timeout=900, env=env, cwd=repo,
         )
         assert out.returncode == 0, out.stderr[-3000:]
         rep = json.loads(out.stdout.strip().splitlines()[-1])
 
-        assert rep["pbytes"] > 8e8, (
-            f"model too small for signal: {rep['pbytes']/1e9:.2f} GB"
-        )
+        fp32_bytes = 4 * cfg.num_params()
+        if quantize is None:
+            assert rep["pbytes"] > 8e8, (
+                f"model too small for signal: {rep['pbytes']/1e9:.2f} GB"
+            )
+            assert rep["pp_matches_dense"]
+        else:
+            # the quantized tree must actually be small — roughly 1/4
+            # (int8) or 1/8 + scales (int4) of fp32
+            assert rep["pbytes"] < 0.45 * fp32_bytes, (
+                f"{quantize} tree is {rep['pbytes']/1e9:.2f} GB vs "
+                f"{fp32_bytes/1e9:.2f} GB fp32 — quantize-on-read inactive?"
+            )
         assert rep["decode_finite"], "70B-shaped decode produced non-finite"
         assert rep["decode_len"] == 33  # 32 prefill + 1 step
-        assert rep["pp_matches_dense"]
         # RSS budget (same bar as test_streamed_load_rss): bounded staging
-        # above the resident shards. Under memory pressure ru_maxrss loses
-        # attribution (near-zero growth for GBs of params) — then the cap
-        # is vacuously satisfied and the load/decode/pp assertions above
-        # still carry the rehearsal.
-        assert rep["rss_delta"] < 1.5 * rep["pbytes"], (
-            f"streamed 70B-shaped load grew RSS {rep['rss_delta']/1e9:.2f} GB"
-            f" for {rep['pbytes']/1e9:.2f} GB of params"
+        # above the resident shards — in particular the fp32 tree must
+        # never materialize during a quantize-on-read load. Under memory
+        # pressure ru_maxrss loses attribution (near-zero growth for GBs
+        # of params) — then the cap is vacuously satisfied and the
+        # load/decode/pp assertions above still carry the rehearsal.
+        budget = 1.5 * rep["pbytes"] + (
+            # quantized loads stage fp32 slices before packing: allow
+            # bounded slice staging, never the full fp32 tree
+            0.25 * fp32_bytes if quantize else 0
+        )
+        assert rep["rss_delta"] < budget, (
+            f"streamed 70B-shaped {quantize or 'fp32'} load grew RSS "
+            f"{rep['rss_delta']/1e9:.2f} GB for {rep['pbytes']/1e9:.2f} GB "
+            "of params"
         )

@@ -81,6 +81,19 @@ def _trees_equal(a, b):
 
 
 class TestStreamedLoad:
+    def test_layernorm_family_without_bias_map_fails_cleanly(self):
+        """A hypothetical non-parallel-block layernorm config (GPT-NeoX
+        style) has no bias entries in the Llama layer map — planning must
+        raise CheckpointError up front, not KeyError mid-plan (round-4
+        advisory)."""
+        from fei_tpu.engine.weights import _plans
+        from fei_tpu.utils.errors import CheckpointError
+
+        cfg = get_model_config("tiny", norm_kind="layernorm",
+                               parallel_block=False)
+        with pytest.raises(CheckpointError, match="layernorm family"):
+            _plans(reader=None, cfg=cfg)  # plans never read at build time
+
     def test_streamed_equals_eager(self, tmp_path):
         cfg = get_model_config("tiny")
         _write_hf_llama(tmp_path, cfg)
