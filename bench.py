@@ -43,6 +43,13 @@ Knobs:
                           the llama3-8b decode suite so 8B + KV fits the
                           16 GB chip; set empty to opt out)
   FEI_TPU_BENCH_STREAMS  (paged suite concurrency, default 4)
+  FEI_TPU_BENCH_CHUNK    (decode-suite fused-scan chunk, default 64: tokens
+                          decoded per device dispatch. Each chunk boundary
+                          is a host sync; over the tunneled backend that is
+                          a WAN round-trip, so the ladder 64/128/256 is the
+                          roofline gap attribution. Non-default chunks get
+                          a -c<N> metric suffix so an A/B run can never
+                          displace the gate headline)
   FEI_TPU_BENCH_MAX_WAIT_S (total backend-retry wall-clock budget, 900)
 """
 
@@ -371,6 +378,8 @@ def _decode_stream_bytes(engine, mean_ctx: int) -> dict:
 def bench_decode(model: str, n_tokens: int) -> int:
     from fei_tpu.engine import GenerationConfig
 
+    chunk = max(1, int(os.environ.get("FEI_TPU_BENCH_CHUNK", "64")))
+
     def build():
         engine = _make_engine(model, max_seq_len=2048)
         prompt = _prompt(engine)
@@ -379,7 +388,7 @@ def bench_decode(model: str, n_tokens: int) -> int:
             max_new_tokens=n_tokens, temperature=0.0, ignore_eos=True
         )
         t0 = time.time()
-        warm = engine.generate_fused(prompt, gen, chunk=64)
+        warm = engine.generate_fused(prompt, gen, chunk=chunk)
         log(f"bench: warm-up (compile) {time.time()-t0:.1f}s, "
             f"{len(warm.token_ids)} tokens")
         return engine, prompt, gen
@@ -400,7 +409,7 @@ def bench_decode(model: str, n_tokens: int) -> int:
 
     ttfts, tps = [], []
     for i in range(3):
-        res = engine.generate_fused(prompt, gen, chunk=64)
+        res = engine.generate_fused(prompt, gen, chunk=chunk)
         ttfts.append(res.ttft_s)
         tps.append(res.decode_tokens_per_s)
         log(f"bench: run {i}: ttft={res.ttft_s*1000:.1f}ms "
@@ -418,7 +427,7 @@ def bench_decode(model: str, n_tokens: int) -> int:
         import jax
 
         with jax.profiler.trace(prof):
-            engine.generate_fused(prompt, gen, chunk=64)
+            engine.generate_fused(prompt, gen, chunk=chunk)
         log(f"bench: profiler trace written to {prof}")
     # Roofline: decode is weight-streaming-bound, so the honest utilization
     # lens is tok/s × bytes-streamed-per-token against the HBM ceiling.
@@ -438,7 +447,10 @@ def bench_decode(model: str, n_tokens: int) -> int:
     mfu = tok_s * flops_per_tok / 197e12
     log(f"bench: est. MFU {mfu*100:.2f}% "
         f"({flops_per_tok/1e9:.1f} GFLOPs/token @ 197 TFLOP/s bf16 peak)")
-    return _emit(f"{_tag(model)}_decode_tok_s_per_chip", tok_s,
+    tag = _tag(model)
+    if chunk != 64:  # A/B arms must never displace the gate headline
+        tag += f"-c{chunk}"
+    return _emit(f"{tag}_decode_tok_s_per_chip", tok_s,
                  extra={
                      "ttft_ms": round(ttft_p50 * 1000, 1),
                      "gb_per_tok": round(sb["total"] / 1e9, 3),

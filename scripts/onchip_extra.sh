@@ -1,0 +1,86 @@
+#!/bin/bash
+# Follow-up on-chip stages, run AFTER scripts/onchip_pipeline.sh completes
+# (wait for /tmp/onchip/DONE) while the backend window is still healthy.
+# Same stage discipline as the main pipeline: stages are never killed from
+# outside (a client killed mid-claim wedges the chip lease), the report is
+# rewritten after every stage, every bench emit persists via
+# FEI_TPU_BENCH_ONCHIP into onchip_state.json.
+#
+# What this run answers (round-5 punch list):
+#  - roofline gap attribution (VERDICT r4 #5): the decode chunk ladder.
+#    generate_fused syncs with the host once per chunk; over the tunneled
+#    backend each sync is a WAN round-trip. 256 decode tokens at chunk=64
+#    pay 3 inter-chunk syncs; chunk=128 pays 1; chunk=256 pays 0. If the
+#    gap between 71.8 tok/s and the ~108 tok/s streaming bound is mostly
+#    (a) host round-trips, the ladder shows it directly — and the fix
+#    (default chunk bump) is a one-line change measurable in-window.
+#  - a jax.profiler trace of one gate-config generation for the same
+#    attribution from the device side.
+#  - phi-2 int4 decode (VERDICT r4 #8): the int4 kernel at a scale that
+#    comfortably fits the chip, independent of the 8B OOM question.
+set -u
+OUT="${OUT:-/tmp/onchip2}"
+REPORT="${REPORT:-/root/repo/ONCHIP_EXTRA.md}"
+mkdir -p "$OUT"
+cd /root/repo
+: > "$OUT/pipeline.log"
+: > "$OUT/stages.lst"
+rm -f "$OUT/DONE"
+echo "=== extra pipeline start $(date -u) ===" >> "$OUT/pipeline.log"
+
+report() {
+  {
+    echo "# On-chip follow-up results (round 5)"
+    echo
+    echo "Produced by scripts/onchip_extra.sh after the main pipeline."
+    echo "Stage logs: $OUT/. Rewritten after every stage."
+    echo
+    echo '## Pipeline log (this run)'
+    echo '```'
+    cat "$OUT/pipeline.log"
+    echo '```'
+    local name
+    while read -r name; do
+      if [ -f "$OUT/$name.log" ]; then
+        echo
+        echo "## $name"
+        echo '```'
+        tail -30 "$OUT/$name.log"
+        echo '```'
+      fi
+    done < "$OUT/stages.lst"
+  } > "$REPORT.tmp"
+  mv -f "$REPORT.tmp" "$REPORT"
+}
+
+stage() {
+  local name="$1"; shift
+  echo "$name" >> "$OUT/stages.lst"
+  echo "[$(date -u +%H:%M:%S)] stage $name start" >> "$OUT/pipeline.log"
+  "$@" > "$OUT/$name.log" 2>&1
+  local rc=$?
+  echo "[$(date -u +%H:%M:%S)] stage $name rc=$rc" >> "$OUT/pipeline.log"
+  report
+}
+
+# 1. decode chunk ladder at the GATE config (8B int8). chunk=64 is the
+# committed gate number's configuration; 128 and 256 halve/eliminate the
+# inter-chunk host syncs. Non-default chunks carry a -c<N> metric suffix so
+# they can never displace the gate headline (bench.py _tag).
+stage chunk128 env FEI_TPU_BENCH_CHUNK=128 FEI_TPU_BENCH_MAX_WAIT_S=300 \
+  python -u bench.py
+stage chunk256 env FEI_TPU_BENCH_CHUNK=256 FEI_TPU_BENCH_MAX_WAIT_S=300 \
+  python -u bench.py
+
+# 2. phi-2 int4 decode: the int4 fallback measurement (2.7B packed ~1.6 GB)
+stage bench_phi2_int4 env FEI_TPU_BENCH_MODEL=phi-2 FEI_TPU_BENCH_QUANT=int4 \
+  FEI_TPU_BENCH_MAX_WAIT_S=300 python -u bench.py
+
+# 3. jax.profiler trace of one gate-config generation (device-side gap
+# attribution; the trace directory is session-local scratch)
+stage profile_gate env FEI_TPU_BENCH_PROFILE=$OUT/profile \
+  FEI_TPU_BENCH_MAX_WAIT_S=300 python -u bench.py
+
+echo "=== extra pipeline done $(date -u) ===" >> "$OUT/pipeline.log"
+report
+touch "$OUT/DONE"

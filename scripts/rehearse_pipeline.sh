@@ -16,7 +16,7 @@
 set -u
 OUT="${OUT:-/tmp/rehearse}"
 mkdir -p "$OUT"
-cd /root/repo
+cd "$(dirname "$0")/.."
 
 # the sitecustomize pins the axon TPU platform; every child must pin CPU
 # (bench.py / int4_diag.py honor the env var via honor_jax_platforms)
@@ -99,6 +99,14 @@ stage ab_spec_off --json -- env FEI_TPU_BENCH_SUITE=paged \
   FEI_TPU_BENCH_STREAMS=1 FEI_TPU_SPECULATE=0 python -u bench.py
 stage ab_spec_on --json -- env FEI_TPU_BENCH_SUITE=paged \
   FEI_TPU_BENCH_STREAMS=1 FEI_TPU_SPECULATE=1 python -u bench.py
+
+# --- round-5 follow-up stages (scripts/onchip_extra.sh) -------------------
+stage chunk128 --json -- env FEI_TPU_BENCH_CHUNK=128 python -u bench.py
+stage chunk256 --json -- env FEI_TPU_BENCH_CHUNK=256 python -u bench.py
+stage bench_phi2_int4 --json -- env FEI_TPU_BENCH_MODEL=tiny-phi \
+  FEI_TPU_BENCH_QUANT=int4 python -u bench.py
+stage profile_gate --json -- env FEI_TPU_BENCH_PROFILE="$OUT/profile" \
+  python -u bench.py
 
 # --- tier-3 re-validation stages: verify the pytest selections collect ----
 stage kernels_collect -- python -m pytest tests/test_pallas_kernels.py \

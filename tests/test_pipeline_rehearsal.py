@@ -53,17 +53,21 @@ def test_pipeline_and_rehearsal_stage_names_agree():
     """A stage added to the on-chip pipeline without a rehearsal is exactly
     the never-run-stage failure mode — fail fast here, cheaply."""
     pipeline = (REPO / "scripts" / "onchip_pipeline.sh").read_text()
+    pipeline += (REPO / "scripts" / "onchip_extra.sh").read_text()
     rehearsal = (REPO / "scripts" / "rehearse_pipeline.sh").read_text()
     import re
 
     stages = re.findall(r"^stage (\w+)", pipeline, flags=re.M)
     assert stages, "no stages parsed from onchip_pipeline.sh"
+    # compare NAME SETS, not substrings: 'bench_paged' must not count as
+    # rehearsed merely because a 'bench_paged_kv8' line mentions it
+    rehearsed = set(re.findall(r"^stage (\w+)", rehearsal, flags=re.M))
     missing = []
     for s in stages:
         if s in ("probe",):  # session-local probe script, not armed work
             continue
         # test-suite stages are rehearsed as _collect variants
-        if s not in rehearsal and f"{s}_collect" not in rehearsal:
+        if s not in rehearsed and f"{s}_collect" not in rehearsed:
             missing.append(s)
     assert not missing, (
         f"pipeline stages without a rehearsal entry: {missing} — add them "
