@@ -204,6 +204,15 @@ def _emit(metric: str, value: float, unit: str = "tok/s/chip",
                 line["last_onchip"] = gate
     elif os.environ.get("FEI_TPU_BENCH_ONCHIP"):
         _record_onchip(line)
+    # attach the live METRICS snapshot (histogram percentiles included) so
+    # BENCH_*.json captures scheduler/engine counters alongside tok/s —
+    # AFTER the gate/record logic so onchip_state.json stays lean
+    try:
+        from fei_tpu.utils.metrics import METRICS
+
+        line["metrics"] = METRICS.snapshot()
+    except Exception:  # noqa: BLE001 — the headline number must survive
+        pass
     print(json.dumps(line), flush=True)
     return 0
 

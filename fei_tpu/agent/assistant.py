@@ -113,6 +113,8 @@ class Assistant:
             resp = await self._complete(system, tools)
             for k, v in (resp.usage or {}).items():
                 self.last_usage[k] = self.last_usage.get(k, 0) + int(v)
+                if k in ("prompt_tokens", "completion_tokens"):
+                    METRICS.incr(f"agent.{k}", int(v))
             if resp.content:
                 final_text.append(resp.content)
             self.conversation.add_assistant_message(resp.content, resp.tool_calls)

@@ -583,6 +583,10 @@ class InferenceEngine:
         tps = (len(out) - 1) / decode_s if len(out) > 1 and decode_s > 0 else 0.0
         METRICS.gauge("last_ttft_s", ttft)
         METRICS.gauge("last_decode_tok_s", tps)
+        if not self.paged:
+            # paged requests observe TTFT in the scheduler (submit→first
+            # token); the dense path records it here instead
+            METRICS.observe("ttft_seconds", ttft)
         return GenerationResult(
             token_ids=out,
             text=self.tokenizer.decode(out),

@@ -30,6 +30,7 @@ import numpy as np
 from fei_tpu.models.configs import ModelConfig
 from fei_tpu.ops.attention import attention
 from fei_tpu.utils.errors import EngineError
+from fei_tpu.utils.metrics import METRICS
 
 
 class PagedKVCache(NamedTuple):
@@ -260,7 +261,9 @@ class PrefixCache:
             if hit is not None:
                 self._clock += 1
                 self._entries[keys[m - 1]] = (hit[0], self._clock)
+                METRICS.incr("prefix.hits")
                 return list(hit[0])
+        METRICS.incr("prefix.misses")
         return []
 
     def register(self, prompt_ids, pages: list[int]) -> None:
@@ -276,6 +279,7 @@ class PrefixCache:
             self._entries[key] = (entry_pages, self._clock)
         while len(self._entries) > self.max_entries:
             self._evict_one()
+        METRICS.gauge("prefix.entries", len(self._entries))
 
     def _evict_one(self) -> bool:
         if not self._entries:
@@ -283,6 +287,8 @@ class PrefixCache:
         key = min(self._entries, key=lambda k: self._entries[k][1])
         pages, _ = self._entries.pop(key)
         self.alloc.drop_ref(list(pages))
+        METRICS.incr("prefix.evictions")
+        METRICS.gauge("prefix.entries", len(self._entries))
         return True
 
     def evict_for(self, pages_wanted: int) -> None:

@@ -13,6 +13,8 @@ scheduler so the admission/decode interleaving invariants are unchanged.
 
 from __future__ import annotations
 
+import time
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -45,6 +47,7 @@ class AdmissionMixin:
                 self.engine._allocator.free(slot)
                 self._slots[slot] = None
                 seq.finished = True
+                self._trace_finish(seq, "failed")
                 seq.out.put(exc)
             return
         while True:
@@ -82,6 +85,7 @@ class AdmissionMixin:
                     # registry references are reclaimable capacity
                     self._prefix.evict_for(need)
                 if need > alloc.free_pages:
+                    METRICS.incr("scheduler.admission_blocked")
                     if prefix:
                         alloc.drop_ref(prefix)
                         # the pin is gone: a page of the memoized match can
@@ -98,6 +102,12 @@ class AdmissionMixin:
                 if prefix:
                     alloc.share(slot, prefix)
                     alloc.drop_ref(prefix)  # pin handed over to the seq ref
+            if seq.trace is not None:
+                seq.trace.event("admitted")
+            METRICS.observe(
+                "queue_wait_seconds", time.perf_counter() - seq.t_queued
+            )
+            self._update_sched_gauges()
             try:
                 # long prompts on an sp mesh admit SEQUENCE-SHARDED in one
                 # dispatch (ring-attention full-model prefill via
@@ -132,6 +142,7 @@ class AdmissionMixin:
                 self.engine._allocator.free(slot)
                 self._slots[slot] = None
                 seq.finished = True
+                self._trace_finish(seq, "failed")
                 seq.out.put(exc)
 
 
@@ -409,6 +420,8 @@ class AdmissionMixin:
         )
         self._keys = self._keys.at[slot].set(rng)
         seq.prefilling = False
+        if seq.trace is not None:
+            seq.trace.event("prefill")
         if self._prefix is not None:
             self._prefix.register(
                 seq.prompt_ids, pages[: alloc.pages_needed(n)]
@@ -543,6 +556,8 @@ class AdmissionMixin:
         )
         self._keys = self._keys.at[slot].set(rng)
         seq.prefilling = False
+        if seq.trace is not None:
+            seq.trace.event("prefill")
         if self._prefix is not None:
             self._prefix.register(seq.prompt_ids, pages[:n_prompt_pages])
 

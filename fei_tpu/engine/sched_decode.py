@@ -279,6 +279,9 @@ class DecodeMixin:
             )
         if mask is not None:
             kw["mask"] = jnp.asarray(mask)
+        METRICS.incr("scheduler.decode_steps", n)
+        METRICS.incr("scheduler.decode_slot_steps", len(active) * n)
+        METRICS.gauge("scheduler.batch_slots_active", len(active))
         with METRICS.span("decode_step"):
             nxt, self._pool, self._keys = step(*args, **kw)
             return np.asarray(nxt)  # host sync inside the span

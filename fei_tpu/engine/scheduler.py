@@ -37,6 +37,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Iterator, Sequence
@@ -48,6 +49,7 @@ import numpy as np
 from fei_tpu.engine.sched_admission import AdmissionMixin
 from fei_tpu.engine.sched_constrain import ConstraintMixin
 from fei_tpu.engine.sched_decode import DecodeMixin
+from fei_tpu.obs.trace import TRACES
 from fei_tpu.utils.errors import EngineError
 from fei_tpu.utils.logging import get_logger
 from fei_tpu.utils.metrics import METRICS
@@ -92,6 +94,11 @@ class _Seq:
     # rolling-buffer SWA: count of leading pages already released back to
     # the pool (positions below every future query's sliding window)
     released_pages: int = 0
+    # observability: request id + lifecycle trace (obs.trace.RequestTrace)
+    # and the submit timestamp queue-wait / TTFT are measured from
+    rid: str = ""
+    trace: object | None = None
+    t_queued: float = 0.0
 
 
 class PagedScheduler(AdmissionMixin, DecodeMixin, ConstraintMixin):
@@ -246,6 +253,10 @@ class PagedScheduler(AdmissionMixin, DecodeMixin, ConstraintMixin):
             stops=eng._stops(gen),
             budget=budget,
         )
+        seq.t_queued = time.perf_counter()
+        seq.trace = TRACES.start(prompt_tokens=n)
+        seq.rid = seq.trace.rid
+        METRICS.incr("scheduler.requests_submitted")
         appended = False
         if grammar is not None:
             if seq.mask_fn is not None:
@@ -302,6 +313,7 @@ class PagedScheduler(AdmissionMixin, DecodeMixin, ConstraintMixin):
                 self._closed = False  # a submit after close() reopens
                 self._waiting.append(seq)
                 self._start_thread()
+        METRICS.gauge("scheduler.queue_depth", len(self._waiting))
         self._wake.set()
         return seq
 
@@ -310,6 +322,7 @@ class PagedScheduler(AdmissionMixin, DecodeMixin, ConstraintMixin):
             if seq in self._waiting:
                 self._waiting.remove(seq)
                 seq.finished = True
+                self._trace_finish(seq, "cancelled")
                 return
             seq.cancelled = True
         self._wake.set()
@@ -410,6 +423,11 @@ class PagedScheduler(AdmissionMixin, DecodeMixin, ConstraintMixin):
             self._finish(seq)
             return
         if emit:
+            if not seq.generated and seq.trace is not None:
+                seq.trace.event("first_token")
+                METRICS.observe(
+                    "ttft_seconds", time.perf_counter() - seq.t_queued
+                )
             seq.generated.append(t)
             seq.out.put(t)
         if not done and seq.gfallback_state is not None:
@@ -474,7 +492,33 @@ class PagedScheduler(AdmissionMixin, DecodeMixin, ConstraintMixin):
             self._pool = self._evict_jit(self._pool, jnp.int32(slot))
             self.engine._allocator.free(slot)
             self._slots[slot] = None
+        self._trace_finish(seq, "cancelled" if seq.cancelled else "completed")
+        self._update_sched_gauges()
         seq.out.put(_DONE)
+
+    def _trace_finish(self, seq: _Seq, status: str) -> None:
+        """Terminal trace event + lifecycle counter (idempotent — the
+        first terminal status wins, matching TraceBuffer.finish)."""
+        tr = seq.trace
+        if tr is None or tr.status != "active":
+            return
+        TRACES.finish(tr, status, completion_tokens=len(seq.generated))
+        METRICS.incr(f"scheduler.requests_{status}")
+
+    def _update_sched_gauges(self) -> None:
+        """Occupancy gauges: queue depth, running slots, page pool."""
+        METRICS.gauge("scheduler.queue_depth", len(self._waiting))
+        METRICS.gauge(
+            "scheduler.running_slots",
+            sum(1 for s in self._slots if s is not None),
+        )
+        alloc = getattr(self.engine, "_allocator", None)
+        if alloc is not None:
+            total = alloc.num_pages - 1  # page 0 is the reserved null page
+            free = alloc.free_pages
+            METRICS.gauge("pool.pages_total", total)
+            METRICS.gauge("pool.pages_free", free)
+            METRICS.gauge("pool.pages_in_use", total - free)
 
     def _drain(self, exc: BaseException) -> None:
         """Fail every queued and in-flight request WITHOUT dropping device
@@ -485,11 +529,13 @@ class PagedScheduler(AdmissionMixin, DecodeMixin, ConstraintMixin):
             self._waiting.clear()
         for s in waiting:
             s.finished = True
+            self._trace_finish(s, "failed")
             s.out.put(exc)
         self._admitting = None
         for s in list(self._slots):
             if s is not None:
                 s.out.put(exc)
+                self._trace_finish(s, "failed")
                 self._finish(s)
 
     def _fail_all(self, exc: BaseException) -> None:
@@ -512,6 +558,7 @@ class PagedScheduler(AdmissionMixin, DecodeMixin, ConstraintMixin):
             self._prefix = None
         for s in doomed:
             s.finished = True
+            self._trace_finish(s, "failed")
             s.out.put(exc)
 
     # -- shared device state ------------------------------------------------

@@ -1,0 +1,27 @@
+"""Observability subsystem: metrics, histograms, request traces, and
+Prometheus exposition. fei_tpu/utils/metrics.py re-exports the METRICS
+singleton from here so pre-existing call sites are unchanged."""
+
+from fei_tpu.obs.metrics import (
+    DEFAULT_BUCKETS,
+    METRICS,
+    Histogram,
+    Metrics,
+)
+from fei_tpu.obs.registry import METRIC_REGISTRY, declared, help_for
+from fei_tpu.obs.render import snapshot_lines
+from fei_tpu.obs.trace import TRACES, RequestTrace, TraceBuffer
+
+__all__ = [
+    "DEFAULT_BUCKETS",
+    "METRICS",
+    "METRIC_REGISTRY",
+    "Histogram",
+    "Metrics",
+    "RequestTrace",
+    "TRACES",
+    "TraceBuffer",
+    "declared",
+    "help_for",
+    "snapshot_lines",
+]

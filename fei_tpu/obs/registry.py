@@ -1,0 +1,130 @@
+"""Declared metric names — the single source of truth for dashboards.
+
+Every ``METRICS.incr/gauge/observe/span/timing`` call site in fei_tpu/
+must use a name declared here (wildcards allowed for families like
+``tool.*``); scripts/metrics_lint.py enforces this in tier-1 so renames
+can't silently break dashboards. docs/OBSERVABILITY.md renders from the
+same table.
+"""
+
+from __future__ import annotations
+
+from fnmatch import fnmatch
+
+# name (or fnmatch pattern) -> (kind, help text)
+METRIC_REGISTRY: dict[str, tuple[str, str]] = {
+    # --- counters -------------------------------------------------------
+    "agent.tool_calls": ("counter", "Tool calls issued by the assistant loop."),
+    "agent.prompt_tokens": ("counter", "Prompt tokens consumed by LLM calls."),
+    "agent.completion_tokens": ("counter",
+                                "Completion tokens produced by LLM calls."),
+    "tool.calls": ("counter", "Tool executions via the registry."),
+    "tool.errors": ("counter", "Tool executions that raised."),
+    "scheduler.requests_submitted": ("counter",
+                                     "Sequences submitted to the scheduler."),
+    "scheduler.requests_completed": ("counter",
+                                     "Sequences finished normally."),
+    "scheduler.requests_cancelled": ("counter", "Sequences cancelled."),
+    "scheduler.requests_failed": ("counter",
+                                  "Sequences failed with an error."),
+    "scheduler.admission_blocked": ("counter",
+                                    "Admissions deferred by page-pool "
+                                    "pressure."),
+    "scheduler.decode_steps": ("counter",
+                               "Device decode steps dispatched."),
+    "scheduler.decode_slot_steps": ("counter",
+                                    "Per-slot decode steps (steps x active "
+                                    "slots)."),
+    "scheduler.paged_prefill_disabled": ("counter",
+                                         "Paged-native prefill fallbacks."),
+    "scheduler.spec_steps": ("counter", "Speculative decode steps."),
+    "scheduler.spec_accepted": ("counter",
+                                "Speculative tokens accepted."),
+    "scheduler.spec_disabled": ("counter",
+                                "Speculation disabled for a sequence."),
+    "scheduler.host_mask_uploads": ("counter",
+                                    "Host-side grammar mask uploads."),
+    "scheduler.multi_steps": ("counter", "Multi-step decode dispatches."),
+    "scheduler.multi_tokens": ("counter",
+                               "Tokens produced by multi-step decode."),
+    "scheduler.swa_pages_released": ("counter",
+                                     "KV pages released by sliding-window "
+                                     "attention."),
+    "scheduler.grammar_trigger_suffix_rejected": (
+        "counter", "Grammar trigger suffixes rejected by the matcher."),
+    "scheduler.grammar_walked_off": (
+        "counter", "Grammar walks that left the trigger automaton."),
+    "engine.sp_prefills": ("counter", "Sequence-parallel prefill launches."),
+    "engine.grammar_trigger_suffix_rejected": (
+        "counter", "Grammar trigger suffixes rejected (engine path)."),
+    "engine.grammar_budget_too_small": (
+        "counter", "Fused grammar chunks skipped: token budget too small."),
+    "engine.grammar_fused_steps": ("counter",
+                                   "Fused grammar-constrained steps."),
+    "engine.grammar_walked_off": (
+        "counter", "Grammar walks off the automaton (engine path)."),
+    "prefix.hits": ("counter", "Prefix-cache hits on admission."),
+    "prefix.misses": ("counter", "Prefix-cache misses on admission."),
+    "prefix.evictions": ("counter", "Prefix-cache entries evicted."),
+    "server.requests": ("counter", "HTTP requests handled by the API core."),
+    "server.profile_captures": ("counter",
+                                "On-demand jax.profiler captures taken."),
+    # --- gauges ---------------------------------------------------------
+    "last_ttft_s": ("gauge", "TTFT of the most recent generation (s)."),
+    "last_decode_tok_s": ("gauge",
+                          "Decode throughput of the most recent "
+                          "generation (tok/s)."),
+    "scheduler.queue_depth": ("gauge", "Sequences waiting for admission."),
+    "scheduler.running_slots": ("gauge", "Sequences actively decoding."),
+    "scheduler.batch_slots_active": ("gauge",
+                                     "Active slots in the last decode "
+                                     "dispatch (batch utilization)."),
+    "pool.pages_total": ("gauge", "Allocatable KV pages (null page "
+                                  "excluded)."),
+    "pool.pages_free": ("gauge", "Free KV pages."),
+    "pool.pages_in_use": ("gauge", "KV pages currently referenced."),
+    "prefix.entries": ("gauge", "Entries resident in the prefix cache."),
+    # --- spans (each also feeds a <name>_seconds histogram) -------------
+    "prefill": ("span", "Full prefill dispatch."),
+    "prefill_chunk": ("span", "One chunked-prefill chunk."),
+    "prefill_sp": ("span", "Sequence-parallel prefill dispatch."),
+    "decode_step": ("span", "One device decode step."),
+    "spec_step": ("span", "One speculative decode step."),
+    "grammar_fused_chunk": ("span", "One fused grammar-constrained chunk."),
+    "agent.completion": ("span", "One LLM call from the assistant loop."),
+    "provider.jax_local": ("span", "One local-engine provider call."),
+    "tool.*": ("span", "One tool execution (per-tool family)."),
+    # --- histograms (observed directly, not via span) -------------------
+    "ttft_seconds": ("histogram",
+                     "Time from submit to first emitted token."),
+    "queue_wait_seconds": ("histogram",
+                           "Time from submit to scheduler admission."),
+}
+
+
+def declared(name: str) -> bool:
+    """True if a call-site metric name is covered by the registry.
+
+    ``name`` may itself contain ``*`` (the lint normalizes f-string
+    ``{...}`` segments to ``*``), so match in both directions.
+    """
+    if name in METRIC_REGISTRY:
+        return True
+    return any(
+        fnmatch(name, pat) or fnmatch(pat, name) for pat in METRIC_REGISTRY
+    )
+
+
+def help_for(name: str) -> tuple[str, str] | None:
+    """(kind, help) for a concrete metric name; ``*_seconds`` histograms
+    derived from spans resolve through their base span name."""
+    if name in METRIC_REGISTRY:
+        return METRIC_REGISTRY[name]
+    for pat, info in METRIC_REGISTRY.items():
+        if "*" in pat and fnmatch(name, pat):
+            return info
+    if name.endswith("_seconds"):
+        base = help_for(name[: -len("_seconds")])
+        if base is not None:
+            return ("histogram", base[1] + " (latency histogram)")
+    return None

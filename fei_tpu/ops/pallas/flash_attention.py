@@ -39,6 +39,12 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax renamed pltpu.TPUCompilerParams -> CompilerParams (jax 0.5); alias so
+# the kernels run on both API generations
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or getattr(
+    pltpu, "TPUCompilerParams"
+)
+
 NEG_INF = -1e30
 _LANES = 128  # lane width for row-stat (lse/D) outputs — Mosaic-native
 
@@ -254,7 +260,7 @@ def _fwd_impl(
         ] + ([
             jax.ShapeDtypeStruct((B, H, T_pad, _LANES), jnp.float32),
         ] if save_lse else []),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -481,7 +487,7 @@ def _bwd_impl(
             scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
         ),
         out_shape=jax.ShapeDtypeStruct((B, H, T_pad, D), q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -543,7 +549,7 @@ def _bwd_impl(
             jax.ShapeDtypeStruct((B, H, S_pad, D), k.dtype),
             jax.ShapeDtypeStruct((B, H, S_pad, D), v.dtype),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,

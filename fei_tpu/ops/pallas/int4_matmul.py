@@ -39,6 +39,12 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax renamed pltpu.TPUCompilerParams -> CompilerParams (jax 0.5); alias so
+# the kernels run on both API generations
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or getattr(
+    pltpu, "TPUCompilerParams"
+)
+
 from fei_tpu.ops.quant import QTensor4, unpack4
 from fei_tpu.utils.logging import get_logger
 
@@ -117,7 +123,7 @@ def _int4_mm_kernel(
         out_specs=pl.BlockSpec((block_m, block_n), lambda m, n, k: (m, n)),
         out_shape=jax.ShapeDtypeStruct((M, N), x.dtype),
         scratch_shapes=[pltpu.VMEM((block_m, block_n), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")
         ),
         interpret=interpret,

@@ -30,6 +30,12 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax renamed pltpu.TPUCompilerParams -> CompilerParams (jax 0.5); alias so
+# the kernels run on both API generations
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or getattr(
+    pltpu, "TPUCompilerParams"
+)
+
 NEG_INF = -1e30
 
 
@@ -197,7 +203,7 @@ def _paged_call(
             ],
         ),
         out_shape=jax.ShapeDtypeStruct((B, K, rows, D), qg.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,

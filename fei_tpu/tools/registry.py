@@ -189,6 +189,7 @@ class ToolRegistry:
         errors = tool.validate_arguments(args)
         if errors:
             raise ToolValidationError(f"invalid arguments for {name}: " + "; ".join(errors))
+        METRICS.incr("tool.calls")
         with METRICS.span(f"tool.{name}"):
             try:
                 result = tool.handler(**args)
@@ -196,8 +197,10 @@ class ToolRegistry:
                     result = self._run_coroutine(result)
                 return result
             except ToolError:
+                METRICS.incr("tool.errors")
                 raise
             except Exception as exc:  # noqa: BLE001 — surfaced to the model
+                METRICS.incr("tool.errors")
                 log.warning("tool %s failed: %s", name, exc)
                 return {"error": f"{type(exc).__name__}: {exc}"}
 

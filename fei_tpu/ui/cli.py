@@ -451,18 +451,17 @@ def main(argv: list[str] | None = None) -> int:
 
 
 def print_stats(assistant=None) -> None:
-    """Span timings + counters + token usage to stderr (observability the
-    reference lacks entirely — SURVEY §5 'Tracing/profiling: none')."""
+    """Span timings + histograms + counters + token usage to stderr
+    (observability the reference lacks entirely — SURVEY §5 'Tracing/
+    profiling: none'). Rendering is shared with the TUI's /metrics command
+    (fei_tpu/obs/render.py) so both UIs show the same table."""
+    from fei_tpu.obs.render import snapshot_lines
     from fei_tpu.utils.metrics import METRICS
 
-    snap = METRICS.snapshot()
     print("\n-- stats ----------------------------------------", file=sys.stderr)
     if assistant is not None and getattr(assistant, "last_usage", None):
         u = assistant.last_usage
         print(f"tokens: prompt={u.get('prompt_tokens', 0)} "
               f"completion={u.get('completion_tokens', 0)}", file=sys.stderr)
-    for name, s in sorted(snap.get("spans", {}).items()):
-        print(f"{name:24s} n={s['count']:<5d} mean={s['mean_s']*1000:8.1f}ms "
-              f"total={s['total_s']:7.2f}s", file=sys.stderr)
-    for name, v in sorted(snap.get("counters", {}).items()):
-        print(f"{name:24s} {v}", file=sys.stderr)
+    for line in snapshot_lines(METRICS.snapshot()):
+        print(line, file=sys.stderr)

@@ -19,12 +19,16 @@ from __future__ import annotations
 import argparse
 import hmac
 import json
+import tempfile
 import threading
 import time
 import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlsplit
 
+from fei_tpu.obs.trace import TRACES
 from fei_tpu.utils.logging import get_logger
+from fei_tpu.utils.metrics import METRICS
 
 log = get_logger("ui.server")
 
@@ -157,6 +161,8 @@ class ServeAPI:
         self.provider = provider
         self.model_name = model_name
         self.api_key = api_key or ""
+        # one jax.profiler capture at a time; a second POST gets 409
+        self._profile_lock = threading.Lock()
 
     def authorized(self, headers: dict) -> bool:
         if not self.api_key:
@@ -176,22 +182,79 @@ class ServeAPI:
     # -- non-streaming ------------------------------------------------------
 
     def handle(self, method: str, path: str, body: dict,
-               headers: dict) -> tuple[int, dict]:
-        if path == "/health":
+               headers: dict) -> tuple[int, dict | str]:
+        """Route a request. A ``str`` payload means plain text (the
+        Prometheus exposition); dicts serialize as JSON."""
+        parts = urlsplit(path)
+        route, query = parts.path, parse_qs(parts.query)
+        METRICS.incr("server.requests")
+        if route == "/health":
             return 200, {"status": "ok", "model": self.model_name}
+        if route == "/metrics" and method == "GET":
+            # pre-auth like /health: scrapers don't carry bearer tokens
+            return 200, METRICS.prometheus_text()
         if not self.authorized(headers):
             return 401, {"error": {"message": "invalid or missing API key",
                                    "type": "authentication_error"}}
-        if path == "/v1/models" and method == "GET":
+        if route == "/v1/models" and method == "GET":
             return 200, {
                 "object": "list",
                 "data": [{"id": self.model_name, "object": "model",
                           "owned_by": "fei-tpu"}],
             }
-        if path == "/v1/chat/completions" and method == "POST":
+        if route == "/v1/traces" and method == "GET":
+            try:
+                limit = int(query.get("limit", ["50"])[0])
+            except ValueError:
+                return 400, {"error": {"message": "limit must be an int",
+                                       "type": "invalid_request_error"}}
+            limit = min(max(limit, 1), 1000)
+            return 200, {"object": "list", "data": TRACES.recent(limit)}
+        if route == "/v1/chat/completions" and method == "POST":
             return self._chat(body)
-        return 404, {"error": {"message": f"no route {method} {path}",
+        if route == "/debug/profile" and method == "POST":
+            return self._profile(body)
+        return 404, {"error": {"message": f"no route {method} {route}",
                                "type": "invalid_request_error"}}
+
+    def _profile(self, body: dict) -> tuple[int, dict]:
+        """On-demand jax.profiler capture: trace the device for N seconds
+        while live traffic keeps flowing, return the trace directory
+        (open it with tensorboard / xprof)."""
+        try:
+            seconds = float(body.get("seconds", 2.0))
+        except (TypeError, ValueError):
+            return 400, {"error": {"message": "seconds must be a number",
+                                   "type": "invalid_request_error"}}
+        if not 0 < seconds <= 60:
+            return 400, {"error": {
+                "message": f"seconds must be in (0, 60], got {seconds}",
+                "type": "invalid_request_error"}}
+        if not self._profile_lock.acquire(blocking=False):
+            return 409, {"error": {
+                "message": "a profile capture is already running",
+                "type": "conflict_error"}}
+        try:
+            import jax
+
+            trace_dir = str(
+                body.get("trace_dir")
+                or tempfile.mkdtemp(prefix="fei-profile-")
+            )
+            jax.profiler.start_trace(trace_dir)
+            try:
+                time.sleep(seconds)
+            finally:
+                jax.profiler.stop_trace()
+            METRICS.incr("server.profile_captures")
+            return 200, {"object": "profile", "trace_dir": trace_dir,
+                         "seconds": seconds}
+        except Exception as exc:  # noqa: BLE001 — profiler issues -> JSON
+            log.warning("profile capture failed: %r", exc)
+            return 500, {"error": {"message": f"{type(exc).__name__}: {exc}",
+                                   "type": "server_error"}}
+        finally:
+            self._profile_lock.release()
 
     def _parse_request(self, body: dict) -> dict:
         """Decode the request into provider kwargs; raises on bad input
@@ -308,10 +371,15 @@ def make_handler(api: ServeAPI):
         def log_message(self, fmt, *args):  # route through our logger
             log.debug("http: " + fmt, *args)
 
-        def _json(self, status: int, payload: dict) -> None:
-            data = json.dumps(payload).encode()
+        def _json(self, status: int, payload: dict | str) -> None:
+            if isinstance(payload, str):  # Prometheus text exposition
+                data = payload.encode("utf-8")
+                ctype = "text/plain; version=0.0.4; charset=utf-8"
+            else:
+                data = json.dumps(payload).encode()
+                ctype = "application/json"
             self.send_response(status)
-            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(data)))
             self.end_headers()
             self.wfile.write(data)

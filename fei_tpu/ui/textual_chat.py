@@ -94,7 +94,7 @@ class MemCommandCompleter:
         if not text.startswith("/"):
             return
         if " " not in text:
-            for cand in ("/mem", "/clear", "/quit", "/help"):
+            for cand in ("/mem", "/metrics", "/clear", "/quit", "/help"):
                 if cand.startswith(text):
                     yield Completion(cand, start_position=-len(text))
             return
@@ -169,6 +169,15 @@ class FeiChatApp:
         if line == "/help":
             self.add_message("system", self._help_text())
             return
+        if line == "/metrics":
+            # live counters/histograms, same table the CLI's --stats prints
+            from fei_tpu.obs.render import snapshot_lines
+            from fei_tpu.utils.metrics import METRICS
+
+            self.add_message(
+                "system", "\n".join(snapshot_lines(METRICS.snapshot()))
+            )
+            return
         if line == "/mem" or line.startswith("/mem "):
             self.add_message("user", line)
             out = self.handle_memory_command(line[len("/mem"):].strip())
@@ -180,8 +189,9 @@ class FeiChatApp:
     def _help_text(self) -> str:
         rows = "\n".join(f"  /mem {k:7s} {v}" for k, v in MEM_COMMANDS.items())
         return (
-            "commands:\n  /clear  reset the conversation\n"
-            "  /quit   exit\n" + rows
+            "commands:\n  /clear    reset the conversation\n"
+            "  /metrics  live engine/agent metrics snapshot\n"
+            "  /quit     exit\n" + rows
         )
 
     def handle_memory_command(self, cmdline: str) -> str:

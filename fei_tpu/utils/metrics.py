@@ -1,102 +1,18 @@
-"""Structured metrics: counters, gauges, and phase timers.
+"""Back-compat shim: the metrics implementation moved to fei_tpu/obs/.
 
-The reference has no tracing/profiling at all (SURVEY.md §5); this module is
-greenfield. It gives every subsystem cheap counters plus wall-clock span timing
-with per-phase aggregation (prefill/decode/tool/llm), and can wrap
-``jax.profiler`` traces when requested.
+The grown observability subsystem (histograms with p50/p95/p99 summaries,
+per-request lifecycle traces, Prometheus exposition) lives in
+fei_tpu.obs; this module re-exports the same names so every pre-existing
+``from fei_tpu.utils.metrics import METRICS`` call site works unchanged.
+See docs/OBSERVABILITY.md.
 """
 
-from __future__ import annotations
+from fei_tpu.obs.metrics import (  # noqa: F401
+    DEFAULT_BUCKETS,
+    METRICS,
+    Histogram,
+    Metrics,
+    _Stat,
+)
 
-import contextlib
-import json
-import threading
-import time
-from collections import defaultdict
-from dataclasses import dataclass
-
-
-@dataclass
-class _Stat:
-    count: int = 0
-    total_s: float = 0.0
-    min_s: float = float("inf")
-    max_s: float = 0.0
-
-    def record(self, dt: float) -> None:
-        self.count += 1
-        self.total_s += dt
-        self.min_s = min(self.min_s, dt)
-        self.max_s = max(self.max_s, dt)
-
-    def as_dict(self) -> dict:
-        mean = self.total_s / self.count if self.count else 0.0
-        return {
-            "count": self.count,
-            "total_s": round(self.total_s, 6),
-            "mean_s": round(mean, 6),
-            "min_s": round(self.min_s, 6) if self.count else 0.0,
-            "max_s": round(self.max_s, 6),
-        }
-
-
-class Metrics:
-    """Thread-safe counters, gauges, and span timers."""
-
-    def __init__(self) -> None:
-        self._lock = threading.Lock()
-        self._counters: dict[str, float] = defaultdict(float)
-        self._gauges: dict[str, float] = {}
-        self._spans: dict[str, _Stat] = defaultdict(_Stat)
-
-    def incr(self, name: str, value: float = 1.0) -> None:
-        with self._lock:
-            self._counters[name] += value
-
-    def gauge(self, name: str, value: float) -> None:
-        with self._lock:
-            self._gauges[name] = value
-
-    @contextlib.contextmanager
-    def span(self, name: str, jax_trace: bool = False):
-        """Time a block; optionally also emit a jax.profiler trace annotation."""
-        ctx = contextlib.nullcontext()
-        if jax_trace:
-            try:
-                import jax
-
-                ctx = jax.profiler.TraceAnnotation(name)
-            except Exception:
-                ctx = contextlib.nullcontext()
-        start = time.perf_counter()
-        try:
-            with ctx:
-                yield
-        finally:
-            dt = time.perf_counter() - start
-            with self._lock:
-                self._spans[name].record(dt)
-
-    def timing(self, name: str, dt: float) -> None:
-        with self._lock:
-            self._spans[name].record(dt)
-
-    def snapshot(self) -> dict:
-        with self._lock:
-            return {
-                "counters": dict(self._counters),
-                "gauges": dict(self._gauges),
-                "spans": {k: v.as_dict() for k, v in self._spans.items()},
-            }
-
-    def dumps(self) -> str:
-        return json.dumps(self.snapshot(), sort_keys=True)
-
-    def reset(self) -> None:
-        with self._lock:
-            self._counters.clear()
-            self._gauges.clear()
-            self._spans.clear()
-
-
-METRICS = Metrics()
+__all__ = ["DEFAULT_BUCKETS", "METRICS", "Histogram", "Metrics"]

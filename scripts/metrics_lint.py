@@ -1,0 +1,67 @@
+#!/usr/bin/env python
+"""Static check: every METRICS call site uses a registered metric name.
+
+Greps fei_tpu/ and bench.py for ``METRICS.incr/gauge/observe/span/timing``
+calls with a literal (or f-string) first argument and fails if the name is
+not declared in fei_tpu/obs/registry.py. F-string ``{...}`` segments
+normalize to ``*`` and match the registry's wildcard families (e.g.
+``tool.{name}`` -> ``tool.*``). Run in tier-1 via tests/test_obs.py so a
+renamed or ad-hoc metric can't silently drift away from dashboards.
+
+Exit status: 0 clean, 1 undeclared names (one line per offending site).
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+# METRICS.incr("name", ...) / METRICS.span(f"tool.{name}") — the first
+# argument must be a (possibly f-) string literal for static checking;
+# dynamically computed names are invisible to dashboards and disallowed.
+_CALL = re.compile(
+    r"METRICS\s*\.\s*(incr|gauge|observe|span|timing)\s*\(\s*(f?)\"([^\"]+)\""
+)
+_FSTRING_FIELD = re.compile(r"\{[^{}]*\}")
+
+
+def scan_tree() -> list[tuple[Path, int, str, str]]:
+    """(file, line, method, normalized name) for every call site."""
+    sites = []
+    files = sorted((REPO / "fei_tpu").rglob("*.py")) + [REPO / "bench.py"]
+    for path in files:
+        text = path.read_text(encoding="utf-8")
+        for m in _CALL.finditer(text):
+            method, is_f, name = m.group(1), m.group(2), m.group(3)
+            if is_f:
+                name = _FSTRING_FIELD.sub("*", name)
+            lineno = text.count("\n", 0, m.start()) + 1
+            sites.append((path, lineno, method, name))
+    return sites
+
+
+def main() -> int:
+    sys.path.insert(0, str(REPO))
+    from fei_tpu.obs.registry import declared
+
+    sites = scan_tree()
+    bad = [s for s in sites if not declared(s[3])]
+    for path, lineno, method, name in bad:
+        rel = path.relative_to(REPO)
+        print(
+            f"{rel}:{lineno}: METRICS.{method}({name!r}) is not declared "
+            "in fei_tpu/obs/registry.py"
+        )
+    if bad:
+        print(f"\n{len(bad)} undeclared metric name(s); add them to "
+              "METRIC_REGISTRY or fix the call site.")
+        return 1
+    print(f"metrics lint: {len(sites)} call sites, all declared")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -280,3 +280,80 @@ class TestLocalEngineServing:
         )
         assert isinstance(resp.content, str)
         assert resp.usage.get("completion_tokens", 0) >= 0
+
+
+class TestObservability:
+    """The /metrics + /v1/traces surface after real engine traffic."""
+
+    def _get(self, port: int, path: str):
+        return urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=60
+        )
+
+    def test_metrics_and_traces_after_streamed_completion(self, local_server):
+        resp = _post(local_server.port, "/v1/chat/completions", {
+            "messages": [{"role": "user", "content": "observe me"}],
+            "max_tokens": 8, "temperature": 0.0, "stream": True,
+        }, stream=True)
+        deltas = []
+        for line in resp:
+            line = line.strip()
+            if line.startswith(b"data: ") and line != b"data: [DONE]":
+                chunk = json.loads(line[len(b"data: "):])
+                deltas.append(chunk["choices"][0]["delta"].get("content", ""))
+        assert "".join(deltas)  # the stream produced tokens
+
+        with self._get(local_server.port, "/metrics") as r:
+            assert r.headers["Content-Type"].startswith("text/plain")
+            text = r.read().decode()
+        assert "fei_ttft_seconds_bucket{le=" in text
+        assert "fei_scheduler_queue_depth" in text
+        assert "# TYPE fei_ttft_seconds histogram" in text
+        assert "fei_scheduler_requests_completed_total" in text
+
+        with self._get(local_server.port, "/v1/traces?limit=10") as r:
+            traces = json.loads(r.read())
+        assert traces["object"] == "list"
+        done = [t for t in traces["data"] if t["status"] == "completed"]
+        assert done, f"no completed trace in {traces['data']!r}"
+        tr = done[0]
+        phases = [s["phase"] for s in tr["spans"]]
+        assert phases[0] == "queued"
+        assert "first_token" in phases
+        assert phases[-1] == "completed"
+        ts = [s["ts"] for s in tr["spans"]]
+        assert ts == sorted(ts)  # monotonically ordered phase timestamps
+        assert tr["completion_tokens"] > 0
+
+    def test_metrics_is_pre_auth_but_traces_requires_key(self):
+        api = ServeAPI(MockProvider(), api_key="sekrit")
+        server = ServingServer(api)
+        server.start()
+        try:
+            with self._get(server.port, "/metrics") as r:
+                assert r.status == 200
+            with pytest.raises(urllib.error.HTTPError) as e:
+                self._get(server.port, "/v1/traces")
+            assert e.value.code == 401
+        finally:
+            server.stop()
+
+    def test_profile_capture_round_trip(self, local_server, tmp_path):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{local_server.port}/debug/profile",
+            data=json.dumps({"seconds": 0.2,
+                             "trace_dir": str(tmp_path)}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=60) as r:
+                body = json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            # the capture hook must fail as JSON, never a dropped socket
+            assert e.code == 500
+            pytest.skip("jax.profiler capture unavailable on this backend")
+        assert body["object"] == "profile"
+        assert body["trace_dir"] == str(tmp_path)
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(local_server.port, "/debug/profile", {"seconds": -1})
+        assert e.value.code == 400

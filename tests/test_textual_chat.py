@@ -140,6 +140,16 @@ class TestDispatch:
         asyncio.run(app.handle_user_message("/mem list"))
         assert app.messages[-1].role == "memory"
 
+    def test_metrics_command(self, app):
+        from fei_tpu.utils.metrics import METRICS
+
+        METRICS.incr("tool.calls")
+        asyncio.run(app.handle_user_message("/metrics"))
+        msg = app.messages[-1]
+        assert msg.role == "system"
+        assert "tool.calls" in msg.content
+        assert "/metrics" in app._help_text()
+
     def test_completer(self):
         from prompt_toolkit.document import Document
 
@@ -150,6 +160,8 @@ class TestDispatch:
         assert "search" in got and "server" in got
         got = [c.text for c in comp.get_completions(Document("/m"), None)]
         assert "/mem" in got
+        got = [c.text for c in comp.get_completions(Document("/me"), None)]
+        assert "/metrics" in got and "/mem" in got
 
     def test_build_app_layout(self, app):
         built = app._build_app()
