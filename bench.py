@@ -143,6 +143,15 @@ def _record_onchip(line: dict) -> None:
     # would carry a non-gate number as the headline (round-4 advisory)
     if line["metric"] == GATE_METRIC:
         state["last_onchip"] = entry
+        # best-AND-latest: the same config measured 71.8 then 30.7 tok/s in
+        # consecutive lease windows (backend variance, not a regression) —
+        # keep the best gate measurement alongside the latest so an outage
+        # report can show both
+        best = state.get("best_onchip")
+        if not best or float(entry.get("value", 0.0)) >= float(
+            best.get("value", 0.0)
+        ):
+            state["best_onchip"] = entry
     tmp = STATE_PATH + ".tmp"
     with open(tmp, "w") as f:
         json.dump(state, f, indent=1, sort_keys=True)
@@ -185,7 +194,8 @@ def _emit(metric: str, value: float, unit: str = "tok/s/chip",
         # still executes. Other suites keep their own (labeled) metric so
         # a mid-pipeline outage cannot masquerade a decode number as a
         # prefill/paged/agent result.
-        gate = _gate_record(_load_state())
+        state = _load_state()
+        gate = _gate_record(state)
         if gate and metric.endswith("_decode_tok_s_per_chip"):
             line = dict(gate)
             line["source"] = f"onchip_state {gate.get('ts', 'unknown')}"
@@ -195,6 +205,14 @@ def _emit(metric: str, value: float, unit: str = "tok/s/chip",
                 "value": round(value, 2),
                 "unit": unit,
             }
+            # headline = LATEST gate measurement; attach the BEST one so
+            # window-to-window backend variance (71.8 -> 30.7 same-config)
+            # reads as variance, not as a framework regression
+            best = state.get("best_onchip")
+            if best:
+                line["best_onchip"] = {
+                    "value": best.get("value"), "ts": best.get("ts"),
+                }
         else:
             # non-decode suite, or no gate record anywhere: label the CPU
             # number honestly; still carry the gate record as metadata so
@@ -816,12 +834,19 @@ def bench_agent(model: str, n_tokens: int) -> int:
             f"{rate:.1f} tok/s"
             + (f", ttft={ttft*1000:.1f}ms" if ttft is not None else ""))
         best = max(best, rate)
-    extra = None
+    # the agent hot path decodes through the fused chunked free phase
+    # (FEI_TPU_DECODE_CHUNK; engine/fused_decode.py) — report the effective
+    # chunk so a dispatch-per-token regression is attributable from the
+    # artifact alone (engine.decode_dispatches rides in the METRICS
+    # snapshot _emit attaches)
+    from fei_tpu.engine.fused_decode import resolve_chunk
+
+    extra = {"decode_chunk": resolve_chunk()}
     if ttfts:
         p50 = sorted(ttfts)[len(ttfts) // 2]
         log(f"bench: agent p50 ttft={p50*1000:.1f}ms (first visible token "
             "through template+provider+engine)")
-        extra = {"ttft_ms": round(p50 * 1000, 1)}
+        extra["ttft_ms"] = round(p50 * 1000, 1)
     return _emit(f"{_tag(model)}_agent_e2e_tok_s_per_chip", best, extra=extra)
 
 

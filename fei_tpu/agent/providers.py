@@ -345,7 +345,13 @@ class JaxLocalProvider(Provider):
         # to plain greedy; multi-token steps whenever output echoes
         # context). Paged engines speculate INSIDE the scheduler
         # (PagedScheduler._maybe_spec_step), so the dense lookahead wrapper
-        # is only selected for the non-paged path.
+        # is only selected for the non-paged path. Every other dense route
+        # below — grammar turns' free phase and plain sampling streams —
+        # decodes FUSED-CHUNKED (engine/fused_decode.py): one device
+        # dispatch per FEI_TPU_DECODE_CHUNK tokens instead of one host
+        # sync per token, which is what closes the agent-e2e vs raw-decode
+        # gap. Override per provider with gen_overrides={"chunk": N}
+        # (1 = per-token reference path).
         speculate = (
             gen.temperature == 0.0
             and not self.engine.paged

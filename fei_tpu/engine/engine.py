@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from fei_tpu.engine.fused_decode import ChunkDecoder, resolve_chunk
 from fei_tpu.engine.sampling import sample_logits
 from fei_tpu.engine.tokenizer import load_tokenizer
 from fei_tpu.models.configs import ModelConfig, get_model_config
@@ -51,6 +52,9 @@ class GenerationConfig:
     min_p: float = 0.0  # drop tokens with prob < min_p * max-prob
     stop_token_ids: tuple[int, ...] = ()
     ignore_eos: bool = False  # benchmark mode: decode the full budget
+    # free-phase fused decode chunk (dense path): 0 → FEI_TPU_DECODE_CHUNK
+    # (default 16), 1 → per-token reference loop, N → N tokens per dispatch
+    chunk: int = 0
 
 
 @dataclass
@@ -439,43 +443,27 @@ class InferenceEngine:
         total = time.perf_counter() - t0
         return self._make_result(out, len(prompt_ids), ttft, total)
 
-    def _fused_fn(
+    def _free_fused_fn(
         self, gen: GenerationConfig, n_steps: int
     ) -> Callable:
-        """One dispatch that decodes ``n_steps`` tokens via lax.scan.
+        """One dispatch that decodes ``n_steps`` free-phase tokens via
+        lax.scan, with an on-device stop-token early-exit
+        (fused_decode.build_fused_decode).
 
         Token-at-a-time streaming pays a host round-trip per token (~tens of
-        ms over a tunneled chip); this amortizes it to one per chunk, which
-        is what bench-grade throughput and batch generation use. The cache
-        (dense or paged pool) is donated through the scan."""
-        key = (gen.temperature, gen.top_k, gen.top_p, gen.min_p, n_steps)
+        ms over a tunneled chip); this amortizes it to one per chunk. The
+        cache is donated through the scan."""
+        from fei_tpu.engine.fused_decode import build_fused_decode
+
+        key = ("free", gen.temperature, gen.top_k, gen.top_p, gen.min_p, n_steps)
         if key not in self._fused_cache:
-            cfg = self.cfg
             fwd = functools.partial(
                 forward, routed_moe=self.mesh is None,
                 moe_mesh=self._moe_mesh(), kernel_mesh=self.mesh,
             )
-            temperature, top_k, top_p, min_p = (
-                gen.temperature, gen.top_k, gen.top_p, gen.min_p
+            self._fused_cache[key] = build_fused_decode(
+                fwd, self.cfg, gen, n_steps
             )
-
-            def fused(params, cache, token, rng):  # token: [B, 1]
-                def body(carry, _):
-                    cache, token, rng = carry
-                    logits, cache = fwd(params, cfg, token, cache)
-                    rng, sub = jax.random.split(rng)
-                    nxt = sample_logits(
-                        logits[:, -1, :], sub,
-                        temperature=temperature, top_k=top_k, top_p=top_p, min_p=min_p,
-                    )
-                    return (cache, nxt[:, None], rng), nxt
-
-                (cache, token, rng), toks = jax.lax.scan(
-                    body, (cache, token, rng), None, length=n_steps
-                )
-                return jnp.swapaxes(toks, 0, 1), cache, token, rng
-
-            self._fused_cache[key] = jax.jit(fused, donate_argnums=(1,))
         return self._fused_cache[key]
 
     # -- generation ---------------------------------------------------------
@@ -707,12 +695,24 @@ class InferenceEngine:
         ``logit_mask_fn`` (for grammar-constrained decoding) maps the tokens
         generated so far to a bool [V] mask of allowed next tokens, or None
         for unconstrained steps.
+
+        Unmasked dense decoding is FUSED-CHUNKED: one device dispatch per
+        ``gen.chunk`` tokens (default ``FEI_TPU_DECODE_CHUNK``=16) with
+        on-device stop early-exit, software-pipelined so the host stop-scan
+        of chunk k overlaps chunk k+1's compute (engine/fused_decode.py).
+        ``gen.chunk=1`` keeps the per-token reference loop; a host
+        ``logit_mask_fn`` forces it (the mask needs every token on host).
         """
         gen = gen or GenerationConfig()
         if self.paged:
             # continuous batching: the scheduler admits this request into a
             # batch slot; any number of concurrent streams share the pool
             yield from self.scheduler.stream(prompt_ids, gen, logit_mask_fn)
+            return
+        if logit_mask_fn is None and resolve_chunk(gen.chunk) > 1:
+            yield from self._stream_chunked(
+                prompt_ids, gen, resolve_chunk(gen.chunk)
+            )
             return
         stops = self._stops(gen)
         generated: list[int] = []
@@ -733,10 +733,39 @@ class InferenceEngine:
             mask = self._pad_mask(logit_mask_fn(generated)) if logit_mask_fn else None
             mask_dev = None if mask is None else mask[None, :]
             with METRICS.span("decode_step"):
+                METRICS.incr("engine.decode_dispatches")
                 tok, cache, rng = step(
                     self.params, cache, tok.reshape(1, 1), rng, mask_dev
                 )
                 tok_host = int(tok[0])  # host sync inside the span
+
+    def _stream_chunked(
+        self, prompt_ids: Sequence[int], gen: GenerationConfig, chunk: int
+    ) -> Iterator[int]:
+        """Fused chunked free decode (dense, unmasked): software-pipelined
+        ChunkDecoder dispatches, host truncation at stops and budget."""
+        stops = self._stops(gen)
+        budget = min(gen.max_new_tokens, self.max_seq_len - len(prompt_ids))
+        tok, cache, rng = self._prefill_sample(prompt_ids, gen)
+        first = int(tok[0])
+        if budget <= 0 or first in stops:
+            return
+        yield first
+        if budget == 1:
+            return
+        dec = ChunkDecoder(
+            self, gen, cache, tok, rng,
+            fed=len(prompt_ids), chunk=chunk, want=budget - 1, stops=stops,
+        )
+        emitted = 1
+        for ch in dec.chunks():
+            for t in ch.tokens:
+                if t in stops:
+                    return
+                yield t
+                emitted += 1
+                if emitted >= budget:
+                    return
 
     def generate_stream_toolcalls(
         self,
@@ -788,29 +817,81 @@ class InferenceEngine:
         stops = self._stops(gen)
         scanner = TriggerScanner(self.tokenizer, trigger)
         tok, cache, rng = self._prefill_sample(prompt_ids, gen)
-        step = self._step_fn(gen)
-        tok_host = int(tok[0])
         gstate = -1
         i = 0
-        # ---- free phase: incremental trigger detection on streamed text --
-        while i < budget:
-            if tok_host in stops:
+        token = tok.reshape(1, 1)
+        free_chunk = resolve_chunk(gen.chunk)
+        if free_chunk > 1:
+            # ---- free phase (fused-chunked): one dispatch per chunk; the
+            # host TriggerScanner runs over the synced [n] token array while
+            # the next chunk computes (software pipelining). A mid-chunk
+            # trigger rolls the cache back to the exact token and re-enters
+            # below as if decoded token-by-token (fused_decode.ChunkDecoder).
+            first = int(tok[0])
+            if budget <= 0 or first in stops:
                 return
-            yield tok_host
-            i += 1
-            suffix = scanner.feed(tok_host)
+            yield first
+            i = 1
+            suffix = scanner.feed(first)
             if suffix is not None:
                 gstate = char_walk(grammar, suffix)
-                if gstate >= 0:
-                    break  # enter the constrained phase
-                METRICS.incr("engine.grammar_trigger_suffix_rejected")
-            if i >= budget:
-                return
-            with METRICS.span("decode_step"):
-                tok, cache, rng = step(
-                    self.params, cache, tok.reshape(1, 1), rng, None
+                if gstate < 0:
+                    METRICS.incr("engine.grammar_trigger_suffix_rejected")
+            if gstate < 0:
+                if i >= budget:
+                    return
+                dec = ChunkDecoder(
+                    self, gen, cache, tok, rng,
+                    fed=len(prompt_ids), chunk=free_chunk, want=budget - 1,
+                    stops=stops,
                 )
-                tok_host = int(tok[0])
+                hit = False
+                for ch in dec.chunks():
+                    for j, t in enumerate(ch.tokens):
+                        if t in stops:
+                            return
+                        yield t
+                        i += 1
+                        suffix = scanner.feed(t)
+                        if suffix is not None:
+                            g = char_walk(grammar, suffix)
+                            if g >= 0:
+                                gstate = g
+                                cache, token, rng = dec.rollback(ch, j)
+                                hit = True
+                                break  # enter the constrained phase
+                            METRICS.incr("engine.grammar_trigger_suffix_rejected")
+                        if i >= budget:
+                            return
+                    if hit:
+                        break
+                if not hit:
+                    return
+        else:
+            # ---- free phase (per-token reference, gen.chunk=1): kept as
+            # the in-tree parity oracle for the fused path ----
+            step = self._step_fn(gen)
+            tok_host = int(tok[0])
+            while i < budget:
+                if tok_host in stops:
+                    return
+                yield tok_host
+                i += 1
+                suffix = scanner.feed(tok_host)
+                if suffix is not None:
+                    gstate = char_walk(grammar, suffix)
+                    if gstate >= 0:
+                        break  # enter the constrained phase
+                    METRICS.incr("engine.grammar_trigger_suffix_rejected")
+                if i >= budget:
+                    return
+                with METRICS.span("decode_step"):
+                    METRICS.incr("engine.decode_dispatches")
+                    tok, cache, rng = step(
+                        self.params, cache, tok.reshape(1, 1), rng, None
+                    )
+                    tok_host = int(tok[0])
+            token = tok.reshape(1, 1)
         if gstate < 0 or i >= budget:
             return
         if gstate == grammar.accept:
@@ -822,7 +903,6 @@ class InferenceEngine:
             METRICS.incr("engine.grammar_budget_too_small")
             return  # cannot complete a valid call; truncate like any budget
         table, min_dist = grammar.device_tables(self.cfg.vocab_size)
-        token = tok.reshape(1, 1)
         gstate_dev = jnp.asarray([gstate], dtype=jnp.int32)
         remaining = jnp.asarray(budget - i, dtype=jnp.int32)
         stop_ids = set(self.tokenizer.stop_token_ids)
@@ -996,49 +1076,24 @@ class InferenceEngine:
         chunk: int = 64,
     ) -> GenerationResult:
         """Chunked high-throughput generation: one device dispatch per
-        ``chunk`` decoded tokens. Stop tokens are honored at chunk
-        granularity (host truncates at the first stop)."""
+        ``chunk`` decoded tokens — the same fused chunked scan the
+        streaming path uses (engine/fused_decode.py), with on-device stop
+        early-exit and the host truncating at the first stop."""
         gen = gen or GenerationConfig()
         if self.paged:
             # paged mode decodes through the continuous-batching scheduler
             # (per-step batching across all in-flight sequences); the chunk
             # knob only applies to the dense single-stream scan
             return self.generate(prompt_ids, gen)
-        stops = self._stops(gen)
         t0 = time.perf_counter()
-        budget = min(gen.max_new_tokens, self.max_seq_len - len(prompt_ids))
-        tok, cache, rng = self._prefill_sample(prompt_ids, gen)
-        # KV slots available for scan writes (each step writes one)
-        slots_left = self.max_seq_len - len(prompt_ids) - 1
-        first = int(tok[0])
-        ttft = time.perf_counter() - t0
+        ttft = None
         out: list[int] = []
-        if budget > 0 and first not in stops:
-            out.append(first)
-            token = tok.reshape(1, 1)
-            remaining = budget - 1
-            while remaining > 0 and slots_left > 0:
-                # always scan a full chunk when the cache has room and
-                # truncate on the host — one compiled program per sampling
-                # config instead of one per tail length
-                n = chunk if slots_left >= chunk else slots_left
-                fused = self._fused_fn(gen, n)
-                toks, cache, token, rng = fused(self.params, cache, token, rng)
-                # ONE host transfer per chunk; indexing the device array per
-                # element would pay a device round-trip per token
-                host = np.asarray(toks)[0, :].tolist()
-                slots_left -= n
-                stopped = False
-                for t in host[: min(n, remaining)]:
-                    if t in stops:
-                        stopped = True
-                        break
-                    out.append(t)
-                if stopped:
-                    break
-                remaining -= n
+        for tok in self._stream_chunked(prompt_ids, gen, max(1, chunk)):
+            if ttft is None:
+                ttft = time.perf_counter() - t0
+            out.append(tok)
         total = time.perf_counter() - t0
-        return self._make_result(out, len(prompt_ids), ttft, total)
+        return self._make_result(out, len(prompt_ids), ttft or 0.0, total)
 
     def chat(self, messages: list[dict], gen: GenerationConfig | None = None) -> GenerationResult:
         ids = self.tokenizer.apply_chat_template(messages, add_generation_prompt=True)

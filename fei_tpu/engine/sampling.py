@@ -44,6 +44,15 @@ def sample_logits(
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
 
+def stop_mask(tokens: jnp.ndarray, stop_ids: jnp.ndarray) -> jnp.ndarray:
+    """[B] bool: membership of each sampled token in ``stop_ids`` ([S]
+    int32; S may be 0 → all False). Runs inside the fused free-phase decode
+    scan (engine/fused_decode.py) to latch the on-device early-exit flag."""
+    if stop_ids.shape[0] == 0:
+        return jnp.zeros(tokens.shape, dtype=jnp.bool_)
+    return jnp.any(tokens[:, None] == stop_ids[None, :], axis=-1)
+
+
 def _sample_row_dynamic(
     logits: jnp.ndarray,  # [V]
     key: jax.Array,

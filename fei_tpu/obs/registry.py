@@ -55,6 +55,11 @@ METRIC_REGISTRY: dict[str, tuple[str, str]] = {
     "scheduler.grammar_walked_off": (
         "counter", "Grammar walks that left the trigger automaton."),
     "engine.sp_prefills": ("counter", "Sequence-parallel prefill launches."),
+    "engine.decode_dispatches": ("counter",
+                                 "Free-phase decode dispatches (fused "
+                                 "chunks or per-token steps) — per-token "
+                                 "regressions show as a jump vs tokens "
+                                 "emitted."),
     "engine.grammar_trigger_suffix_rejected": (
         "counter", "Grammar trigger suffixes rejected (engine path)."),
     "engine.grammar_budget_too_small": (
@@ -89,6 +94,8 @@ METRIC_REGISTRY: dict[str, tuple[str, str]] = {
     "prefill_chunk": ("span", "One chunked-prefill chunk."),
     "prefill_sp": ("span", "Sequence-parallel prefill dispatch."),
     "decode_step": ("span", "One device decode step."),
+    "decode_chunk": ("span", "One fused free-phase decode chunk (the "
+                             "blocking host sync; dispatch is pipelined)."),
     "spec_step": ("span", "One speculative decode step."),
     "grammar_fused_chunk": ("span", "One fused grammar-constrained chunk."),
     "agent.completion": ("span", "One LLM call from the assistant loop."),

@@ -58,6 +58,16 @@ _BLOCK_N = 256
 _BLOCK_K2_CANDIDATES = (4096, 2048, 1024)
 
 _mosaic_probe_cache: dict[tuple, bool] = {}  # per-(bm,bn,bk2,gs) preflight
+
+
+def _try(fn) -> Exception | None:
+    """Run ``fn``, returning the exception instead of raising (threads
+    swallow exceptions; the preflight needs them back on the caller)."""
+    try:
+        fn()
+        return None
+    except Exception as e:  # noqa: BLE001 — preflight must never raise
+        return e
 _kernel_invocations = 0  # fused-kernel dispatches (tests pin kernel vs fallback)
 
 
@@ -184,20 +194,41 @@ def _mosaic_ok(block_m: int, block_n: int, block_k2: int, gs: int) -> bool:
     of crashing the engine's compiled-call site. Probing the exact
     (bm, bn, bk2, gs) matters: a minimal shape compiling says nothing
     about a 4096-row block's VMEM footprint."""
-    if jax.default_backend() != "tpu":
+    on_tpu = jax.default_backend() == "tpu"
+    if not on_tpu and os.environ.get("FEI_TPU_INT4_PREFLIGHT") != "1":
         return True  # interpret mode: no Mosaic involved
     key = (block_m, block_n, block_k2, gs)
     hit = _mosaic_probe_cache.get(key)
     if hit is not None:
         return hit
-    try:
+    # int4_mm is usually TRACED inside the engine's jitted programs; run
+    # mid-trace, the probe arrays would be tracers and block_until_ready
+    # would raise AttributeError, silently latching the XLA fallback for
+    # every real run (the round-5 chip window measured int4 SLOWER than
+    # int8 for exactly this reason). JAX's trace stack is thread-local, so
+    # a fresh thread gives the probe a guaranteed-eager context no matter
+    # what the caller is tracing. FEI_TPU_INT4_PREFLIGHT=1 forces the probe
+    # off-TPU (interpret mode) so the mid-trace path stays testable on CPU.
+    def probe():
         x = jnp.zeros((block_m, 2 * block_k2), jnp.bfloat16)
         p = jnp.zeros((block_k2, block_n), jnp.int8)
         s = jnp.zeros((2 * block_k2 // gs, block_n), jnp.float32)
-        _int4_mm_kernel(
+        jax.block_until_ready(_int4_mm_kernel(
             x, p, s, block_m=block_m, block_n=block_n, block_k2=block_k2,
-            interpret=False,
-        ).block_until_ready()
+            interpret=not on_tpu,
+        ))
+
+    try:
+        import threading
+
+        box: list = []
+        t = threading.Thread(
+            target=lambda: box.append(_try(probe)), name="int4-preflight"
+        )
+        t.start()
+        t.join()
+        if box and box[0] is not None:
+            raise box[0]
         _mosaic_probe_cache[key] = True
     except Exception as e:
         _mosaic_probe_cache[key] = False

@@ -99,6 +99,50 @@ def test_cpu_fallback_headline_is_gate_record(bench, monkeypatch, capsys):
     assert "tiny" not in json.dumps(state)
 
 
+def test_best_onchip_tracks_max_gate_value(bench, monkeypatch, capsys):
+    """best-AND-latest: consecutive lease windows measured 71.8 then 30.7
+    tok/s for the SAME config (backend variance). The latest value owns
+    last_onchip; the best survives in its own slot."""
+    monkeypatch.setenv("FEI_TPU_BENCH_ONCHIP", "1")
+    bench._emit(bench.GATE_METRIC, 71.81)
+    bench._emit(bench.GATE_METRIC, 30.7)
+    state = json.loads(Path(bench.STATE_PATH).read_text())
+    assert state["last_onchip"]["value"] == 30.7
+    assert state["best_onchip"]["value"] == 71.81
+    bench._emit(bench.GATE_METRIC, 80.0)
+    state = json.loads(Path(bench.STATE_PATH).read_text())
+    assert state["last_onchip"]["value"] == 80.0
+    assert state["best_onchip"]["value"] == 80.0
+
+
+def test_best_onchip_ignores_non_gate_suites(bench, monkeypatch, capsys):
+    monkeypatch.setenv("FEI_TPU_BENCH_ONCHIP", "1")
+    bench._emit("llama3-8b-int4_decode_tok_s_per_chip", 500.0)
+    state = json.loads(Path(bench.STATE_PATH).read_text())
+    assert "best_onchip" not in state
+    bench._emit(bench.GATE_METRIC, 70.0)
+    state = json.loads(Path(bench.STATE_PATH).read_text())
+    assert state["best_onchip"]["value"] == 70.0  # int4's 500 never counted
+
+
+def test_cpu_fallback_reports_best_and_latest(bench, monkeypatch, capsys):
+    """Outage headline = LATEST gate number (stale-marked), with the BEST
+    one attached so window-to-window variance reads as variance, not as a
+    framework regression."""
+    monkeypatch.setenv("FEI_TPU_BENCH_ONCHIP", "1")
+    bench._emit(bench.GATE_METRIC, 71.81)
+    bench._emit(bench.GATE_METRIC, 30.7)
+    capsys.readouterr()
+    monkeypatch.delenv("FEI_TPU_BENCH_ONCHIP")
+    monkeypatch.setenv("FEI_TPU_BENCH_CPU_FALLBACK", "1")
+    bench._emit("tiny_decode_tok_s_per_chip", 239.4)
+    line = _last_line(capsys)
+    assert line["value"] == 30.7
+    assert line["stale"] is True
+    assert line["best_onchip"]["value"] == 71.81
+    assert "ts" in line["best_onchip"]
+
+
 def test_cpu_fallback_never_promotes_non_gate(bench, monkeypatch, capsys):
     """With only non-gate suites recorded, the fallback must keep the
     honest CPU label instead of promoting a non-gate number."""

@@ -50,13 +50,24 @@ def test_sampling_seed_reproducible(engine):
 
 def test_stop_token_halts_stream(engine):
     ids = engine.tokenizer.encode("stop", add_bos=True)
-    greedy = engine.generate(ids, GenerationConfig(max_new_tokens=8))
-    assert len(greedy.token_ids) >= 2
+    # reference run ignores EOS so it always yields the full budget — the
+    # tiny random model's greedy stream may open with a natural stop token
+    # (numerics shift across jax versions), which must not sink the test
+    greedy = engine.generate(
+        ids, GenerationConfig(max_new_tokens=8, ignore_eos=True)
+    )
+    assert len(greedy.token_ids) == 8
     stop_at = greedy.token_ids[1]
+    stops = {stop_at} | set(engine.tokenizer.stop_token_ids)
+    expect = []
+    for t in greedy.token_ids:
+        if t in stops:
+            break
+        expect.append(t)
     stopped = engine.generate(
         ids, GenerationConfig(max_new_tokens=8, stop_token_ids=(stop_at,))
     )
-    assert stopped.token_ids == greedy.token_ids[:1]
+    assert stopped.token_ids == expect
 
 
 def test_logit_mask_constrains_output(engine):

@@ -103,6 +103,60 @@ class TestInt4Matmul:
         assert mm(x, qt).shape == (2, 3, 256)
 
 
+class TestMosaicPreflight:
+    """The preflight must run EAGERLY even when int4_mm is being traced
+    inside an enclosing jit (the engine's normal call site) — a mid-trace
+    probe that touches tracers would latch the XLA fallback forever.
+    FEI_TPU_INT4_PREFLIGHT=1 forces the probe on CPU (interpret mode)."""
+
+    def test_preflight_under_jit_selects_kernel(self, monkeypatch):
+        import fei_tpu.ops.pallas.int4_matmul as m
+
+        monkeypatch.setenv("FEI_TPU_INT4_PREFLIGHT", "1")
+        monkeypatch.setattr(m, "_mosaic_probe_cache", {})
+        w = jax.random.normal(jax.random.PRNGKey(0), (2048, 256)) * 0.05
+        qt = quantize4(w)
+        x = jax.random.normal(jax.random.PRNGKey(1), (8, 2048), jnp.bfloat16)
+        before = m._kernel_invocations
+
+        out = jax.jit(lambda x: int4_mm(x, qt))(x)
+
+        # the probe ran on its own (eager) thread mid-trace and latched ok
+        assert list(m._mosaic_probe_cache.values()) == [True]
+        assert m._kernel_invocations == before + 1  # Pallas path, not XLA
+        out_x = int4_mm_xla(x, qt)
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), np.asarray(out_x, np.float32),
+            atol=2e-2,
+        )
+
+    def test_failed_preflight_latches_fallback(self, monkeypatch):
+        import fei_tpu.ops.pallas.int4_matmul as m
+
+        monkeypatch.setenv("FEI_TPU_INT4_PREFLIGHT", "1")
+        monkeypatch.setattr(m, "_mosaic_probe_cache", {})
+
+        def boom(*a, **k):
+            raise RuntimeError("mosaic says no")
+
+        monkeypatch.setattr(m, "_int4_mm_kernel", boom)
+        w = jax.random.normal(jax.random.PRNGKey(0), (2048, 256)) * 0.05
+        qt = quantize4(w)
+        x = jax.random.normal(jax.random.PRNGKey(1), (8, 2048), jnp.bfloat16)
+        before = m._kernel_invocations
+
+        out = jax.jit(lambda x: int4_mm(x, qt))(x)
+
+        # rejection latched; the call routed through XLA without raising
+        assert list(m._mosaic_probe_cache.values()) == [False]
+        assert m._kernel_invocations == before
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32),
+            np.asarray(int4_mm_xla(x, qt), np.float32),
+            atol=2e-2,
+        )
+
+
 class TestMixedTreeRules:
     def test_lm_head_and_moe_experts_stay_int8(self):
         params = {
