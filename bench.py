@@ -602,8 +602,12 @@ def bench_paged(model: str, n_tokens: int) -> int:
     if retry:
         engine, consume, errors = build_and_warm()
 
-    best = 0.0
-    for run in range(2):
+    # headline = MEDIAN of >= 3 measured runs: max() rewarded one lucky
+    # scheduling window and made run-to-run regressions invisible
+    # (VERDICT r5); the median is stable against a single outlier in
+    # either direction while per-run rates stay in the emitted extras.
+    rates: list[float] = []
+    for run in range(3):
         counts = [0] * streams
         errors.clear()
         threads = [
@@ -621,7 +625,7 @@ def bench_paged(model: str, n_tokens: int) -> int:
         agg = sum(counts) / dt
         log(f"bench: paged run {run}: {sum(counts)} tokens in {dt:.1f}s "
             f"-> {agg:.1f} tok/s aggregate")
-        best = max(best, agg)
+        rates.append(agg)
     kv = os.environ.get("FEI_TPU_BENCH_KV_QUANT")
     tag = _tag(model)
     if kv:
@@ -633,7 +637,9 @@ def bench_paged(model: str, n_tokens: int) -> int:
     if sp is not None:  # both arms of the spec A/B must persist
         tag += f"-spec{sp}"
     return _emit(
-        f"{tag}_paged_{streams}stream_agg_tok_s_per_chip", best
+        f"{tag}_paged_{streams}stream_agg_tok_s_per_chip",
+        sorted(rates)[len(rates) // 2],
+        extra={"runs_tok_s": [round(r, 2) for r in rates]},
     )
 
 
@@ -824,7 +830,9 @@ def bench_agent(model: str, n_tokens: int) -> int:
         retry = True
     if retry:
         turn = build()
-    best, ttfts = 0.0, []
+    # median of the 3 measured runs, same rationale as bench_paged: max()
+    # hid run-to-run regressions behind one lucky window (VERDICT r5)
+    rates, ttfts = [], []
     for run in range(3):
         toks, dt, ttft = turn()
         rate = toks / dt if dt > 0 else 0.0
@@ -833,7 +841,7 @@ def bench_agent(model: str, n_tokens: int) -> int:
         log(f"bench: agent run {run}: {toks} tokens in {dt:.1f}s -> "
             f"{rate:.1f} tok/s"
             + (f", ttft={ttft*1000:.1f}ms" if ttft is not None else ""))
-        best = max(best, rate)
+        rates.append(rate)
     # the agent hot path decodes through the fused chunked free phase
     # (FEI_TPU_DECODE_CHUNK; engine/fused_decode.py) — report the effective
     # chunk so a dispatch-per-token regression is attributable from the
@@ -841,13 +849,20 @@ def bench_agent(model: str, n_tokens: int) -> int:
     # snapshot _emit attaches)
     from fei_tpu.engine.fused_decode import resolve_chunk
 
-    extra = {"decode_chunk": resolve_chunk()}
+    extra = {
+        "decode_chunk": resolve_chunk(),
+        "runs_tok_s": [round(r, 2) for r in rates],
+    }
     if ttfts:
         p50 = sorted(ttfts)[len(ttfts) // 2]
         log(f"bench: agent p50 ttft={p50*1000:.1f}ms (first visible token "
             "through template+provider+engine)")
         extra["ttft_ms"] = round(p50 * 1000, 1)
-    return _emit(f"{_tag(model)}_agent_e2e_tok_s_per_chip", best, extra=extra)
+    return _emit(
+        f"{_tag(model)}_agent_e2e_tok_s_per_chip",
+        sorted(rates)[len(rates) // 2],
+        extra=extra,
+    )
 
 
 def main() -> int:

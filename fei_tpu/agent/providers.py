@@ -331,21 +331,27 @@ class JaxLocalProvider(Provider):
         out_ids: list[int] = []
         # Incremental decode: re-decoding the whole sequence per token is
         # O(n^2); instead decode a bounded pending window and fold it into
-        # ``stable`` at a clean UTF-8 boundary (no trailing U+FFFD). A few
-        # tokens of context carry across the fold so tokenizers that strip
-        # a leading space on the first decoded token (sentencepiece) don't
-        # glue words together at chunk boundaries.
+        # ``stable`` at every clean UTF-8 boundary (no trailing U+FFFD), so
+        # the window stays a handful of tokens and each step decodes
+        # O(context), not O(stream). A few tokens of context carry across
+        # the fold so tokenizers that strip a leading space on the first
+        # decoded token (sentencepiece) don't glue words together at fold
+        # boundaries; ``ctx_text`` caches the context decode between folds.
         stable = ""
         ctx: list[int] = []
+        ctx_text = ""
         pending: list[int] = []
         text_so_far = ""
         emitted = 0
         grammar = self._tool_grammar(tools)
-        # greedy agent turns use prompt-lookup speculation (token-identical
-        # to plain greedy; multi-token steps whenever output echoes
-        # context). Paged engines speculate INSIDE the scheduler
-        # (PagedScheduler._maybe_spec_step), so the dense lookahead wrapper
-        # is only selected for the non-paged path. Every other dense route
+        # prompt-lookup speculation is OPT-IN (FEI_TPU_SPECULATE=1): the
+        # round-5 on-chip A/B measured the draft-verify dispatches costing
+        # 43% of single-stream throughput (spec on 32.73 vs off 58.28
+        # tok/s), so the default path amortizes dispatches with fused
+        # chunks instead. When enabled, greedy agent turns use the dense
+        # lookahead wrapper (token-identical to plain greedy); paged
+        # engines speculate INSIDE the scheduler
+        # (PagedScheduler._maybe_spec_step). Every other dense route
         # below — grammar turns' free phase and plain sampling streams —
         # decodes FUSED-CHUNKED (engine/fused_decode.py): one device
         # dispatch per FEI_TPU_DECODE_CHUNK tokens instead of one host
@@ -356,7 +362,7 @@ class JaxLocalProvider(Provider):
             gen.temperature == 0.0
             and not self.engine.paged
             and grammar is None
-            and os.environ.get("FEI_TPU_SPECULATE", "1") != "0"
+            and os.environ.get("FEI_TPU_SPECULATE", "0") == "1"
         )
         if grammar is not None:
             import functools
@@ -382,15 +388,23 @@ class JaxLocalProvider(Provider):
                     self.last_ttft_s = time.perf_counter() - t_start
                 out_ids.append(tok)
                 pending.append(tok)
-                ctx_text = self.engine.tokenizer.decode(ctx) if ctx else ""
                 tail = self.engine.tokenizer.decode(ctx + pending)[len(ctx_text):]
                 text_so_far = stable + tail
-                if len(pending) >= 128 and tail and not tail.endswith("�"):
-                    stable, ctx, pending = text_so_far, pending[-8:], []
+                if tail and not tail.endswith("�"):
+                    stable, ctx, pending = text_so_far, (ctx + pending)[-8:], []
+                    ctx_text = self.engine.tokenizer.decode(ctx)
                 visible = stream_visible(text_so_far, self.tool_trigger)
-                if len(visible) > emitted:
-                    yield visible[emitted:]
-                    emitted = len(visible)
+                # hold back a trailing U+FFFD run: it may be an incomplete
+                # UTF-8 sequence the next token completes IN PLACE, and a
+                # chunk already yielded cannot be retracted — the diff
+                # cursor would skip the corrected char forever
+                safe = len(visible.rstrip("�"))
+                if safe > emitted:
+                    yield visible[emitted:safe]
+                    emitted = safe
+        visible = stream_visible(text_so_far, self.tool_trigger)
+        if len(visible) > emitted:
+            yield visible[emitted:]
         content, calls = extract_tool_calls(text_so_far, self.tool_trigger)
         return ProviderResponse(
             content=content,

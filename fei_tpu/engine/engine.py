@@ -30,7 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from fei_tpu.engine.fused_decode import ChunkDecoder, resolve_chunk
+from fei_tpu.engine.fused_decode import ChunkDecoder, resolve_chunk, trigger_walk
 from fei_tpu.engine.sampling import sample_logits
 from fei_tpu.engine.tokenizer import load_tokenizer
 from fei_tpu.models.configs import ModelConfig, get_model_config
@@ -799,7 +799,7 @@ class InferenceEngine:
         if grammar is None:
             yield from self.generate_stream(prompt_ids, gen)
             return
-        from fei_tpu.engine.grammar import TriggerScanner, char_walk
+        from fei_tpu.engine.grammar import TriggerScanner
 
         close_ids = self.tokenizer.encode(close)
         budget = min(gen.max_new_tokens, self.max_seq_len - len(prompt_ids))
@@ -832,9 +832,9 @@ class InferenceEngine:
                 return
             yield first
             i = 1
-            suffix = scanner.feed(first)
-            if suffix is not None:
-                gstate = char_walk(grammar, suffix)
+            g0 = trigger_walk(grammar, scanner, first)
+            if g0 is not None:
+                gstate = g0
                 if gstate < 0:
                     METRICS.incr("engine.grammar_trigger_suffix_rejected")
             if gstate < 0:
@@ -852,9 +852,8 @@ class InferenceEngine:
                             return
                         yield t
                         i += 1
-                        suffix = scanner.feed(t)
-                        if suffix is not None:
-                            g = char_walk(grammar, suffix)
+                        g = trigger_walk(grammar, scanner, t)
+                        if g is not None:
                             if g >= 0:
                                 gstate = g
                                 cache, token, rng = dec.rollback(ch, j)
@@ -877,9 +876,9 @@ class InferenceEngine:
                     return
                 yield tok_host
                 i += 1
-                suffix = scanner.feed(tok_host)
-                if suffix is not None:
-                    gstate = char_walk(grammar, suffix)
+                g = trigger_walk(grammar, scanner, tok_host)
+                if g is not None:
+                    gstate = g
                     if gstate >= 0:
                         break  # enter the constrained phase
                     METRICS.incr("engine.grammar_trigger_suffix_rejected")

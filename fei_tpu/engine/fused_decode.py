@@ -45,6 +45,25 @@ from fei_tpu.utils.metrics import METRICS
 DEFAULT_CHUNK = 16
 
 
+def trigger_walk(grammar, scanner, token_id: int) -> int | None:
+    """One host trigger-watch step of the grammar FREE phase, shared by
+    every free-phase consumer (the dense ChunkDecoder loops in
+    ``engine.generate_stream_toolcalls`` and the paged scheduler's
+    ``_grammar_advance``) so mid-chunk rollback decisions cannot drift
+    between engines. Feeds one sampled token to the ``TriggerScanner``;
+    returns ``None`` while no new trigger occurrence has completed,
+    otherwise the DFA state reached by char-walking the post-trigger
+    suffix: ``grammar.accept`` when the token carried a whole call,
+    ``>= 0`` to enter constrained decode there, ``< 0`` when the DFA
+    rejects the suffix (callers count the rejection and stay free)."""
+    from fei_tpu.engine.grammar import char_walk
+
+    suffix = scanner.feed(token_id)
+    if suffix is None:
+        return None
+    return char_walk(grammar, suffix)
+
+
 def resolve_chunk(gen_chunk: int = 0) -> int:
     """Effective free-phase decode chunk.
 

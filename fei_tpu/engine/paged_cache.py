@@ -88,6 +88,16 @@ class PagedKVCache(NamedTuple):
         )
 
 
+def replace_lengths(pool: "PagedKVCache", lengths) -> "PagedKVCache":
+    """Host-authoritative per-slot length override: swap ONLY the ``[B]``
+    lengths leaf. This is the rollback primitive shared by speculative
+    verification and the scheduler's turbo-scan free phase — positions at
+    or above a slot's new length are unreachable (decode attends strictly
+    below ``lengths``) and later writes land at the running length,
+    overwriting any rolled-back garbage in place."""
+    return pool._replace(lengths=jnp.asarray(lengths, dtype=jnp.int32))
+
+
 def quant_kv_rows(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Symmetric int8 over the last (head_dim) axis: per-token, per-head
     scales. Returns (int8 values, fp32 scales with the D axis dropped).

@@ -37,7 +37,12 @@ class AdmissionMixin:
         eventually runs instead of starving behind smaller latecomers.
 
         A chunked admission in flight gets exactly one chunk of prefill per
-        call, so the caller's loop interleaves it with decode steps."""
+        call, so the caller's loop interleaves it with decode steps — and
+        since the turbo scan stays armed under admissions
+        (sched_decode._try_multi_step), the interleave is one prefill
+        chunk per N-step scan: live streams keep amortizing host syncs
+        while the new request prefills, and the admission stalls for at
+        most one scan between chunks (bounded stall preserved)."""
         if self._admitting is not None:
             seq, slot = self._admitting["seq"], self._admitting["slot"]
             try:

@@ -41,14 +41,15 @@ class ConstraintMixin:
         """Advance the host DFA mirror with sampled token ``t``.
         Returns (emit_token, finish_now). The device step applied the same
         table, so the mirror walk can only land where the mask allowed."""
-        from fei_tpu.engine.grammar import char_walk
+        from fei_tpu.engine.fused_decode import trigger_walk
 
         g = seq.grammar
         if seq.gstate < 0:
-            # free phase: watch the streamed text for the trigger
-            suffix = seq.gscanner.feed(t)
-            if suffix is not None:
-                s = char_walk(g, suffix)
+            # free phase: watch the streamed text for the trigger — the
+            # shared walk used by the dense fused path, so the turbo scan's
+            # mid-chunk rollback decision cannot drift from it
+            s = trigger_walk(g, seq.gscanner, t)
+            if s is not None:
                 if s == g.accept:  # whole call inside the trigger token
                     seq.gaccepted = True
                     return True, True

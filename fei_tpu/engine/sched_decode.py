@@ -123,9 +123,11 @@ class DecodeMixin:
             # s.next_input at position L0 + delivered. The block wrote T
             # rows, so shrink the slot's length — inactive slots' lengths
             # return to 0 (their writes landed in the null page)
+            from fei_tpu.engine.paged_cache import replace_lengths
+
             lengths = np.zeros((self.B,), dtype=np.int32)
             lengths[b] = L0 + delivered
-            self._pool = self._pool._replace(lengths=jnp.asarray(lengths))
+            self._pool = replace_lengths(self._pool, lengths)
         return True
 
 
@@ -199,42 +201,124 @@ class DecodeMixin:
     def _try_multi_step(self) -> bool:
         """Run up to ``self.multistep`` decode steps in ONE device dispatch.
 
-        Eligible only when the host has nothing to do between steps: no
-        queued or in-flight admission, every armed slot maskless and not
-        in a grammar free phase (the trigger scanner must see each token
-        as it streams), and every slot has >= N budget left — so tokens
-        decoded past a mid-scan stop stay inside the slot's reserved
-        pages (they are never delivered, and prefix-cache registration
-        only covers delivered tokens, so garbage positions are
-        unreachable). Constrained slots are fine: the scan advances their
-        DFA states on device exactly like the dense fused path."""
+        The turbo scan is the scheduler's STEADY state, not a fair-weather
+        fast path:
+
+        - **Admission overlap.** Queued or in-flight chunked admissions do
+          not disarm it. The loop already runs ``_admit_ready`` (one
+          prefill-chunk dispatch) before ``_step_active``, so one chunk
+          interleaves with one N-step scan per iteration — live streams
+          keep amortizing host syncs while a request prefills, and the
+          admission's bounded-stall guarantee (at most one scan between
+          chunks) is preserved. Chunk-prefilling slots sit outside
+          ``active``: their block-table row is still zeroed, so the scan's
+          writes for them land in the null page, exactly as on the
+          single-step path.
+        - **Fused free phase.** Grammar slots in their FREE phase
+          (``gstate < 0`` — the bulk of an agent turn) scan speculatively:
+          the host walks the returned tokens through the TriggerScanner at
+          delivery, and when the trigger completes at step ``i < n-1`` the
+          slot rolls back — pool length to the exact token, rng key to the
+          stacked per-step key — and re-enters device-native constrained
+          decode token-identically to per-token stepping (see
+          ``_rollback_slots``). Tokens discarded by the rollback stay
+          inside the slot's reserved pages and are never attended, the
+          same argument as the mid-scan-stop rule below.
+
+        Still ineligible: a host ``mask_fn`` on any armed slot (the mask
+        must be re-evaluated between steps), and < 2 steps of headroom.
+        Headroom is the MAX over active slots, not the min: a slot that
+        reaches its budget (or a stop) mid-scan is finished at delivery
+        and its scanned tail discarded — tokens past the stop sit in the
+        slot's reserved pages (out-of-range positions route to the null
+        page; the eviction zeroes its row and length) and are never
+        delivered, so a nearly-done stream must not throttle the whole
+        batch to single-step dispatches. For the same reason ``n`` rounds
+        UP to the next power of two covering the deepest remaining
+        budget (capped at ``multistep``) rather than down: rounding down
+        makes every stream tail decay through a 4-2-1 dispatch ladder,
+        while rounding up finishes it in one scan at the cost of < 2x
+        the tail's useful compute in discarded steps — the right trade
+        in the dispatch-bound regime this path exists for. Constrained
+        slots (``gstate >= 0``) advance their DFA states on device
+        exactly like the dense fused path."""
         cap = self.multistep
-        if cap <= 1 or self._waiting or self._admitting is not None:
+        if cap <= 1:
             return False
-        active = [(b, s) for b, s in enumerate(self._slots) if s is not None]
+        active = [
+            (b, s) for b, s in enumerate(self._slots)
+            if s is not None and not s.prefilling
+        ]
         if not active:
             return False
         for _, s in active:
-            if s.prefilling or s.mask_fn is not None:
+            if s.mask_fn is not None:
                 return False
-            if s.grammar is not None and s.gstate < 0:
-                return False
-        headroom = min(s.budget - len(s.generated) for _, s in active)
+        headroom = max(s.budget - len(s.generated) for _, s in active)
         n = 1
-        while n * 2 <= min(cap, headroom):
+        while n < headroom and n < cap:
             n *= 2
         if n <= 1:
             return False
+        under_admission = bool(self._waiting) or self._admitting is not None
 
         toks = self._dispatch_steps(active, n)
         METRICS.incr("scheduler.multi_steps")
         METRICS.incr("scheduler.multi_tokens", n)
-        for i in range(n):
-            for b, s in active:
+        if under_admission:
+            METRICS.incr("scheduler.turbo_under_admission")
+        rollback: dict[int, int] = {}
+        for b, s in active:
+            for i in range(n):
                 if self._slots[b] is not s:  # finished at an earlier step
-                    continue
+                    break
+                was_free = s.grammar is not None and s.gstate < 0
                 self._deliver(s, int(toks[b, i]))
+                if (
+                    was_free
+                    and i < n - 1
+                    and self._slots[b] is s
+                    and s.gstate >= 0
+                ):
+                    # the tool-call trigger completed mid-scan: the steps
+                    # past i were sampled unconstrained — discard them and
+                    # re-enter constrained decode from the exact token
+                    rollback[b] = i
+                    break
+        if rollback:
+            self._rollback_slots(rollback, n)
         return True
+
+
+    def _rollback_slots(self, rollback: dict[int, int], n: int) -> None:
+        """Roll mid-scan-triggered slots back to their delivered frontier.
+
+        ``rollback`` maps slot index -> last delivered scan step ``i``.
+        Pool lengths are recomputed for EVERY slot from host-authoritative
+        sequence state (prompt + generated, minus the pending next_input
+        whose KV is written when fed) — for slots that delivered the full
+        scan this equals the scan's own final length, for finished or
+        prefilling slots it is 0, matching eviction/armed bring-up — and
+        each rolled-back slot's rng key is restored from the stacked
+        per-step keys, i.e. the state after exactly ``i + 1`` splits, the
+        same chain the per-token reference path would hold after
+        delivering ``i + 1`` tokens. Discarded KV positions sit in the
+        slot's reserved pages above the new length and are never attended;
+        the next dispatch overwrites them slot-by-slot."""
+        from fei_tpu.engine.paged_cache import replace_lengths
+
+        lengths = np.zeros((self.B,), dtype=np.int32)
+        for b, s in enumerate(self._slots):
+            if s is not None and not s.prefilling:
+                lengths[b] = len(s.prompt_ids) + len(s.generated) - 1
+        self._pool = replace_lengths(self._pool, lengths)
+        for b, i in rollback.items():
+            self._keys = self._keys.at[b].set(self._step_keys[i, b])
+        METRICS.incr("scheduler.turbo_rollbacks", len(rollback))
+        METRICS.incr(
+            "scheduler.turbo_rollback_tokens",
+            sum(n - 1 - i for i in rollback.values()),
+        )
 
 
     def _dispatch_steps(
@@ -244,7 +328,10 @@ class DecodeMixin:
         ``n`` scanned decode steps in one compiled dispatch; returns the
         sampled tokens [B, n] (ONE host sync for the whole scan). A host
         ``mask`` ([B, V] bool) only composes with n == 1 — host masks must
-        be re-evaluated between steps."""
+        be re-evaluated between steps. The stacked per-step rng keys land
+        in ``self._step_keys`` ([n, B, 2], stays on device) so a
+        free-phase trigger rollback can restore a slot's exact mid-scan
+        key state."""
         eng = self.engine
         B = self.B
         tokens = np.zeros((B, 1), dtype=np.int32)
@@ -283,7 +370,7 @@ class DecodeMixin:
         METRICS.incr("scheduler.decode_slot_steps", len(active) * n)
         METRICS.gauge("scheduler.batch_slots_active", len(active))
         with METRICS.span("decode_step"):
-            nxt, self._pool, self._keys = step(*args, **kw)
+            nxt, self._step_keys, self._pool, self._keys = step(*args, **kw)
             return np.asarray(nxt)  # host sync inside the span
 
 
@@ -337,14 +424,20 @@ class DecodeMixin:
                         carry = (pool, nxt[:, None], new_keys, gstates, gremain)
                     else:
                         carry = (pool, nxt[:, None], new_keys)
-                    return carry, nxt
+                    return carry, (nxt, new_keys)
 
                 init = (
                     (pool, tokens, keys, gstates, gremain) if grammared
                     else (pool, tokens, keys)
                 )
-                carry, toks = jax.lax.scan(body, init, None, length=n_steps)
-                return jnp.swapaxes(toks, 0, 1), carry[0], carry[2]
+                carry, (toks, step_keys) = jax.lax.scan(
+                    body, init, None, length=n_steps
+                )
+                # step_keys[i] is the key state after i+1 splits — exactly
+                # the per-token reference chain after delivering i+1 tokens,
+                # so the host can re-enter mid-scan (free-phase trigger
+                # rollback) with bit-identical seeded sampling
+                return jnp.swapaxes(toks, 0, 1), step_keys, carry[0], carry[2]
 
             self._step_jit[key] = jax.jit(multi, donate_argnums=(1,))
         return self._step_jit[key]

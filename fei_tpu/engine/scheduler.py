@@ -129,6 +129,7 @@ class PagedScheduler(AdmissionMixin, DecodeMixin, ConstraintMixin):
         self._thread: threading.Thread | None = None
         self._pool = None  # owned page pool (donated through every dispatch)
         self._keys = None  # [B, 2] per-slot PRNG keys
+        self._step_keys = None  # [n, B, 2] stacked keys of the last scan
         self._step_jit: dict = {}
         self._admit_jit: dict = {}
         self._chunk_jit: dict = {}
@@ -146,12 +147,15 @@ class PagedScheduler(AdmissionMixin, DecodeMixin, ConstraintMixin):
         self.sp_admit_factor = int(
             _os.environ.get("FEI_TPU_SP_ADMIT_FACTOR", "8")
         )
-        # prompt-lookup speculation for the single-stream paged case (the
-        # agent serving shape): greedy echoes of prompt content verify in
-        # one multi-token dispatch. FEI_TPU_SPECULATE=0 disables.
+        # prompt-lookup speculation for the single-stream paged case:
+        # greedy echoes of prompt content verify in one multi-token
+        # dispatch. OPT-IN (FEI_TPU_SPECULATE=1): the round-5 on-chip A/B
+        # measured the draft-verify dispatches costing 43% of single-stream
+        # throughput (spec on 32.73 vs off 58.28 tok/s) — the turbo scan is
+        # the default dispatch-amortization path instead.
         self.spec_ngram = int(_os.environ.get("FEI_TPU_SPEC_NGRAM", "3"))
         self.spec_draft_len = int(_os.environ.get("FEI_TPU_SPEC_DRAFT", "8"))
-        self.speculate = _os.environ.get("FEI_TPU_SPECULATE", "1") != "0"
+        self.speculate = _os.environ.get("FEI_TPU_SPECULATE", "0") == "1"
         # paged-NATIVE chunked prefill: admission chunks write K/V straight
         # into pool pages and attend via the multi-query block kernel
         # through a one-slot pool view — no dense staging cache (bucket ×
@@ -163,12 +167,16 @@ class PagedScheduler(AdmissionMixin, DecodeMixin, ConstraintMixin):
             _os.environ.get("FEI_TPU_PAGED_PREFILL", "1") != "0"
         )
         # multi-step decode: scan up to N batched steps inside ONE device
-        # dispatch when nothing needs the host between steps (no pending
-        # admission, no host masks, no grammar trigger-watching). The
-        # per-step host round-trip otherwise bounds aggregate throughput
-        # (over the tunneled backend it IS the step time); the cost is up
-        # to N steps of extra admission latency for a request that arrives
-        # mid-dispatch. FEI_TPU_SCHED_MULTISTEP=1 disables.
+        # dispatch — the scheduler's steady state. Runs under queued and
+        # chunked admissions (one prefill chunk interleaves with one scan
+        # per loop iteration) and through the grammar free phase (the scan
+        # speculates; a mid-scan trigger rolls pool lengths + rng key back
+        # to the exact token — sched_decode._try_multi_step). Only host
+        # masks force per-token stepping. The per-step host round-trip
+        # otherwise bounds aggregate throughput (over the tunneled backend
+        # it IS the step time); the cost is up to N steps of extra
+        # admission latency for a request that arrives mid-dispatch.
+        # FEI_TPU_SCHED_MULTISTEP=1 disables.
         self.multistep = max(
             1, int(_os.environ.get("FEI_TPU_SCHED_MULTISTEP", "8"))
         )
@@ -453,13 +461,14 @@ class PagedScheduler(AdmissionMixin, DecodeMixin, ConstraintMixin):
         """Rolling-buffer SWA: pages wholly below (pos - window - margin)
         return to the pool mid-stream — the decode kernels' index maps
         clamp past them, so they are never read OR DMA'd again. The margin
-        covers speculation rollback (a rejected draft shrinks the length by
-        at most the draft; a page released under the longer length must
-        still be below the window after the shrink) plus one page of
-        slack for the multi-token block writes."""
+        covers the deepest mid-stream length shrink — a rejected spec
+        draft OR a turbo-scan grammar rollback (up to ``multistep - 1``
+        scanned tokens discarded); a page released under the longer
+        length must still be below the window after the shrink — plus one
+        page of slack for the multi-token block writes."""
         W = self.engine.cfg.sliding_window
         ps = self.engine.page_size
-        margin = self.spec_draft_len + ps
+        margin = max(self.spec_draft_len, self.multistep) + ps
         cur = len(seq.prompt_ids) + len(seq.generated)
         releasable = max(0, (cur - W - margin)) // ps
         if releasable > seq.released_pages:

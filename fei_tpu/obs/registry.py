@@ -47,6 +47,15 @@ METRIC_REGISTRY: dict[str, tuple[str, str]] = {
     "scheduler.multi_steps": ("counter", "Multi-step decode dispatches."),
     "scheduler.multi_tokens": ("counter",
                                "Tokens produced by multi-step decode."),
+    "scheduler.turbo_under_admission": (
+        "counter", "Multi-step dispatches run while an admission was "
+                   "queued or prefilling in chunks."),
+    "scheduler.turbo_rollbacks": (
+        "counter", "Free-phase slots rolled back to a mid-scan grammar "
+                   "trigger (pool length + rng key restored)."),
+    "scheduler.turbo_rollback_tokens": (
+        "counter", "Scanned-ahead tokens discarded by free-phase trigger "
+                   "rollbacks."),
     "scheduler.swa_pages_released": ("counter",
                                      "KV pages released by sliding-window "
                                      "attention."),

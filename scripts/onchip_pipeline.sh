@@ -4,13 +4,19 @@
 # killed from outside — a client killed mid-claim wedges the chip lease
 # (see .claude/skills/verify/SKILL.md gotchas).
 #
-# Round-4 ordering (VERDICT r3 #2): the chip window can be SHORT (round 3's
-# lasted 16 minutes and got through 6 of 11 stages) — so never-measured
-# stages run FIRST and re-validation of things already proven on-chip in
-# round 3 runs last. Every bench stage persists its result into
-# /root/repo/onchip_state.json via bench.py (FEI_TPU_BENCH_ONCHIP), so the
-# driver's end-of-round BENCH artifact carries the numbers even if the
-# backend is down at snapshot time.
+# Round-6 ordering (VERDICT r5 #5, revising the r4 rule): KERNEL
+# CORRECTNESS runs before any perf stage — in r5 the kernel suites ran
+# last and the window truncated them, so a whole round of perf numbers
+# shipped with the kernels they depend on unvalidated. Each correctness
+# stage is capped with pytest's in-process --timeout (tests/conftest.py;
+# SIGALRM inside the process — stages are still never killed from
+# OUTSIDE, a client killed mid-claim wedges the chip lease), so a hung
+# Mosaic compile costs minutes, not the window. After correctness, the
+# never-measured perf stages run before re-validation, as in r4. Every
+# bench stage persists its result into /root/repo/onchip_state.json via
+# bench.py (FEI_TPU_BENCH_ONCHIP), so the driver's end-of-round BENCH
+# artifact carries the numbers even if the backend is down at snapshot
+# time.
 #
 # The report is rewritten into the repo after EVERY stage, so results
 # survive even if a later stage hangs and the session ends: the driver
@@ -69,6 +75,21 @@ if [ -f /tmp/tpu_probe.py ]; then
   stage probe python -u /tmp/tpu_probe.py
 fi
 
+# ---- TIER 0: kernel correctness FIRST (VERDICT r5 #5). Perf numbers from
+# kernels that were never validated in-window are not results. Capped per
+# test with the in-process --timeout so a hung compile can't eat the
+# window. ----
+
+# 0a. Mosaic kernel validation (flash fwd/bwd + SWA, paged, int8-KV,
+# mq-ragged, sliding-window)
+stage kernels env FEI_TPU_TEST_PLATFORM=tpu python -m pytest \
+  tests/test_pallas_kernels.py tests/test_kv_quant.py \
+  tests/test_sliding_window.py -q --timeout 120
+
+# 0b. flash-attention backward on-chip (jax.grad through the pallas kernels)
+stage flash_grad env FEI_TPU_TEST_PLATFORM=tpu python -m pytest \
+  tests/test_flash_in_model.py -q --timeout 180
+
 # ---- TIER 1: the gate + everything never measured on-chip (r3 stages 6b-9
 # plus the r4 additions). Run these while the window is young. ----
 
@@ -96,7 +117,7 @@ stage bench_8b_paged_8s env FEI_TPU_BENCH_SUITE=paged \
 # r3 #3: 8B int4 RESOURCE_EXHAUSTED with the kernel fine standalone),
 # then the 8B int4 decode bench
 stage int4_tests env FEI_TPU_TEST_PLATFORM=tpu python -m pytest \
-  tests/test_int4.py -q
+  tests/test_int4.py -q --timeout 120
 stage int4_diag python -u scripts/int4_diag.py
 stage bench_8b_int4 env FEI_TPU_BENCH_QUANT=int4 FEI_TPU_BENCH_MAX_WAIT_S=300 \
   python -u bench.py
@@ -129,19 +150,10 @@ stage ab_spec_on env FEI_TPU_BENCH_SUITE=paged FEI_TPU_BENCH_STREAMS=1 \
   FEI_TPU_SPECULATE=1 FEI_TPU_BENCH_MAX_WAIT_S=300 python -u bench.py
 
 # ---- TIER 3: re-validation of suites already green on-chip in round 3
-# (kernels/flash-bwd/paged-1b/moe) — confirm nothing regressed. ----
+# (paged-1b/moe) — confirm nothing regressed. The kernel suites moved to
+# tier 0. ----
 
-# 8. Mosaic kernel validation (flash fwd/bwd + SWA, paged, int8-KV,
-# mq-ragged, sliding-window)
-stage kernels env FEI_TPU_TEST_PLATFORM=tpu python -m pytest \
-  tests/test_pallas_kernels.py tests/test_kv_quant.py \
-  tests/test_sliding_window.py -q
-
-# 9. flash-attention backward on-chip (jax.grad through the pallas kernels)
-stage flash_grad env FEI_TPU_TEST_PLATFORM=tpu python -m pytest \
-  tests/test_flash_in_model.py -q
-
-# 10. 1B paged + moe re-validation (r3 numbers: 175.7 / 188.4 / 141.9)
+# 8. 1B paged + moe re-validation (r3 numbers: 175.7 / 188.4 / 141.9)
 stage bench_paged env FEI_TPU_BENCH_SUITE=paged FEI_TPU_BENCH_MAX_WAIT_S=300 \
   python -u bench.py
 stage bench_paged_kv8 env FEI_TPU_BENCH_SUITE=paged FEI_TPU_BENCH_KV_QUANT=int8 \

@@ -77,7 +77,7 @@ stage bench_8b_paged_8s --json -- env FEI_TPU_BENCH_SUITE=paged \
 # 4. int4: test collection, the ladder diagnostic (same code path, tiny
 # ladder), the int4 decode bench
 stage int4_tests_collect -- python -m pytest tests/test_int4.py \
-  --collect-only -q
+  --collect-only -q --timeout 120
 stage int4_diag -- env FEI_TPU_INT4_DIAG_MODEL=tiny \
   FEI_TPU_INT4_DIAG_LADDER=1,2 python -u scripts/int4_diag.py
 stage bench_8b_int4 --json -- env FEI_TPU_BENCH_QUANT=int4 python -u bench.py
@@ -108,11 +108,14 @@ stage bench_phi2_int4 --json -- env FEI_TPU_BENCH_MODEL=tiny-phi \
 stage profile_gate --json -- env FEI_TPU_BENCH_PROFILE="$OUT/profile" \
   python -u bench.py
 
-# --- tier-3 re-validation stages: verify the pytest selections collect ----
+# --- tier-0 correctness stages: verify the pytest selections collect AND
+# that the armed --timeout flag resolves (in-process cap from
+# tests/conftest.py — an unknown flag would burn the on-chip stage) ----
 stage kernels_collect -- python -m pytest tests/test_pallas_kernels.py \
-  tests/test_kv_quant.py tests/test_sliding_window.py --collect-only -q
+  tests/test_kv_quant.py tests/test_sliding_window.py --collect-only -q \
+  --timeout 120
 stage flash_grad_collect -- python -m pytest tests/test_flash_in_model.py \
-  --collect-only -q
+  --collect-only -q --timeout 180
 stage bench_paged --json -- env FEI_TPU_BENCH_SUITE=paged python -u bench.py
 stage bench_paged_kv8 --json -- env FEI_TPU_BENCH_SUITE=paged \
   FEI_TPU_BENCH_KV_QUANT=int8 python -u bench.py

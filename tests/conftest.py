@@ -57,3 +57,53 @@ def tmp_home(tmp_path, monkeypatch):
     """Isolated $HOME so config/memdir tests never touch the real one."""
     monkeypatch.setenv("HOME", str(tmp_path))
     return tmp_path
+
+
+def pytest_addoption(parser):
+    """Minimal in-process per-test timeout (``--timeout SECONDS``).
+
+    The on-chip pipeline must cap its kernel-correctness stages (VERDICT
+    r5 #5: they ran last and got truncated) but can NEVER kill pytest from
+    outside — a client killed mid-claim wedges the chip lease
+    (scripts/onchip_pipeline.sh header). The pytest-timeout plugin is not
+    installed in the image, so this registers the same flag with the same
+    semantics we need: SIGALRM raises inside the test, the process exits
+    normally, the lease survives. Off (0) unless passed, so tier-1 runs
+    are untouched."""
+    try:
+        parser.addoption(
+            "--timeout", type=float, default=0.0,
+            help="fail any single test exceeding SECONDS (0 = no limit; "
+                 "in-process SIGALRM, main thread only)",
+        )
+    except ValueError:
+        pass  # a real pytest-timeout plugin is installed and owns the flag
+
+
+@pytest.fixture(autouse=True)
+def _per_test_timeout(request):
+    import signal
+    import threading
+
+    limit = float(request.config.getoption("--timeout", 0.0) or 0.0)
+    if (
+        limit <= 0
+        or os.name != "posix"
+        or threading.current_thread() is not threading.main_thread()
+    ):
+        yield
+        return
+
+    def _expired(signum, frame):
+        pytest.fail(
+            f"test exceeded --timeout={limit:g}s (in-process cap)",
+            pytrace=False,
+        )
+
+    old_handler = signal.signal(signal.SIGALRM, _expired)
+    signal.setitimer(signal.ITIMER_REAL, limit)
+    try:
+        yield
+    finally:
+        signal.setitimer(signal.ITIMER_REAL, 0.0)
+        signal.signal(signal.SIGALRM, old_handler)

@@ -227,6 +227,53 @@ class TestJaxLocalProvider:
             outs[flag] = provider.complete(msgs, max_tokens=12).content
         assert outs["1"] == outs["0"]
 
+    def test_stream_detok_byte_identical(self, monkeypatch):
+        """The stream loop detokenizes incrementally (bounded pending
+        window + cached context decode) instead of re-decoding the whole
+        sequence per token; the streamed text must stay byte-identical
+        to a from-scratch decode of every emitted token id."""
+        import jax.numpy as jnp
+
+        from fei_tpu.agent.providers import (
+            JaxLocalProvider,
+            extract_tool_calls,
+            stream_visible,
+        )
+        from fei_tpu.engine import InferenceEngine
+
+        monkeypatch.setenv("FEI_TPU_SPECULATE", "0")
+        engine = InferenceEngine.from_config(
+            "tiny", dtype=jnp.float32, max_seq_len=512, tokenizer="byte"
+        )
+        provider = JaxLocalProvider(engine=engine, gen_overrides={"ignore_eos": True})
+        captured: list[int] = []
+        real = engine.generate_stream
+
+        def spy(ids, gen, **kw):
+            for t in real(ids, gen, **kw):
+                captured.append(t)
+                yield t
+
+        monkeypatch.setattr(engine, "generate_stream", spy)
+        # byte tokenizer + a random tiny model: the stream crosses plenty of
+        # invalid / partial UTF-8 boundaries, the hard case for folding
+        gen = provider.stream(
+            [{"role": "user", "content": "héllo ✓ bytes"}], max_tokens=48
+        )
+        chunks = []
+        while True:
+            try:
+                chunks.append(next(gen))
+            except StopIteration as fin:
+                resp = fin.value
+                break
+        assert len(captured) == 48
+        full = engine.tokenizer.decode(captured)
+        assert "".join(chunks) == stream_visible(full, provider.tool_trigger)
+        content, _ = extract_tool_calls(full, provider.tool_trigger)
+        assert resp.content == content
+        assert resp.usage["completion_tokens"] == 48
+
     def test_assistant_over_local_engine(self):
         import jax.numpy as jnp
 
