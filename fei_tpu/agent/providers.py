@@ -30,7 +30,11 @@ from dataclasses import dataclass, field
 from typing import Any, Sequence
 
 from fei_tpu.utils.config import get_config
-from fei_tpu.utils.errors import AuthenticationError, ProviderError
+from fei_tpu.utils.errors import (
+    AuthenticationError,
+    ProviderError,
+    RateLimitError,
+)
 from fei_tpu.utils.logging import get_logger
 from fei_tpu.utils.metrics import METRICS
 
@@ -263,10 +267,12 @@ class JaxLocalProvider(Provider):
             log.warning("unhashable tool list (%s); tool grammar disabled", exc)
             return None
         if key not in self._grammar_cache:
+            from fei_tpu.engine.faults import FAULTS
             from fei_tpu.engine.grammar import compile_agent_tool_grammar
             from fei_tpu.utils.errors import EngineError
 
             try:
+                FAULTS.check("grammar.compile", tools=key)
                 g = compile_agent_tool_grammar(tools, self.engine.tokenizer)
                 log.info(
                     "tool-call grammar compiled: %d tools, %d states, "
@@ -541,6 +547,84 @@ class RemoteProvider(Provider):
             for t in tools
         ]
 
+    @staticmethod
+    def _retry_after_s(headers) -> float | None:
+        """Parse a Retry-After header (integer-seconds form only; the
+        HTTP-date form is rare among API providers and falls back to the
+        computed backoff)."""
+        try:
+            val = headers.get("Retry-After") if headers is not None else None
+            return None if val is None else max(0.0, float(val))
+        except (TypeError, ValueError):
+            return None
+
+    def _post_with_retries(self, req) -> dict:
+        """POST ``req`` with bounded retries: connection errors and
+        429/5xx statuses retry with exponential backoff + full jitter,
+        honoring ``Retry-After`` when the server sends one. Other HTTP
+        errors (auth, bad request) and malformed 200s fail immediately —
+        retrying those just burns the budget. Each retry increments the
+        ``provider.retries`` counter; the ``provider.http`` fault point
+        sits inside the loop so injected transport faults exercise
+        exactly this path."""
+        import random
+        import urllib.error
+        import urllib.request
+
+        from fei_tpu.engine.faults import FAULTS
+
+        retries = max(0, int(os.environ.get("FEI_TPU_PROVIDER_RETRIES", "3")))
+        timeout = float(os.environ.get("FEI_TPU_PROVIDER_TIMEOUT_S", "120"))
+        backoff = float(os.environ.get("FEI_TPU_PROVIDER_BACKOFF_S", "0.5"))
+        last_exc: Exception | None = None
+        for attempt in range(retries + 1):
+            retry_after = None
+            try:
+                FAULTS.check("provider.http", attempt=attempt)
+                with urllib.request.urlopen(req, timeout=timeout) as resp:
+                    raw = resp.read()
+                try:
+                    return json.loads(raw)
+                except ValueError as exc:  # malformed 200: not retryable
+                    raise ProviderError(
+                        f"remote completion failed: {exc}", cause=exc
+                    ) from exc
+            except urllib.error.HTTPError as exc:
+                if exc.code != 429 and exc.code < 500:
+                    raise ProviderError(
+                        f"remote completion failed: {exc}", cause=exc
+                    ) from exc
+                retry_after = self._retry_after_s(exc.headers)
+                last_exc = exc
+            except (urllib.error.URLError, ConnectionError, OSError,
+                    TimeoutError) as exc:
+                last_exc = exc
+            if attempt >= retries:
+                break
+            delay = retry_after
+            if delay is None:
+                # full jitter over the exponential envelope, capped: a
+                # thundering herd of synchronized clients is exactly the
+                # load shape the server-side breaker exists to survive
+                delay = random.uniform(0, min(backoff * 2 ** attempt, 30.0))
+            METRICS.incr("provider.retries")
+            log.warning(
+                "remote completion attempt %d/%d failed (%r); retrying "
+                "in %.2fs", attempt + 1, retries + 1, last_exc, delay,
+            )
+            time.sleep(delay)
+        import urllib.error as _ue
+
+        if isinstance(last_exc, _ue.HTTPError) and last_exc.code == 429:
+            raise RateLimitError(
+                f"remote endpoint rate-limited after {retries + 1} "
+                f"attempts: {last_exc}", cause=last_exc,
+            ) from last_exc
+        raise ProviderError(
+            f"remote completion failed after {retries + 1} attempts: "
+            f"{last_exc}", cause=last_exc,
+        ) from last_exc
+
     def _complete_urllib(self, msgs, tools, max_tokens) -> "ProviderResponse":
         """OpenAI-compatible /chat/completions via urllib (no litellm)."""
         import urllib.request
@@ -560,9 +644,8 @@ class RemoteProvider(Provider):
             },
             method="POST",
         )
+        body = self._post_with_retries(req)
         try:
-            with urllib.request.urlopen(req, timeout=120) as resp:
-                body = json.loads(resp.read())
             # error-shaped 200s ({"error": {...}} or empty choices) are a
             # real pattern among OpenAI-compatible servers
             if "error" in body:

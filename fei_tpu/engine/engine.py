@@ -55,6 +55,11 @@ class GenerationConfig:
     # free-phase fused decode chunk (dense path): 0 → FEI_TPU_DECODE_CHUNK
     # (default 16), 1 → per-token reference loop, N → N tokens per dispatch
     chunk: int = 0
+    # wall-clock budget from submit, seconds: 0 → FEI_TPU_DEFAULT_DEADLINE_S
+    # (0 = none). Enforced by the paged scheduler at admission (expired
+    # queue wait sheds) and at delivery (mid-decode cancel,
+    # ``deadline_exceeded`` in traces); the dense path ignores it.
+    deadline_s: float = 0.0
 
 
 @dataclass

@@ -1,0 +1,159 @@
+"""Deterministic fault injection for the serving stack.
+
+Robustness claims are only as good as the failure paths that back them,
+and real device faults (Mosaic rejecting a kernel, a consumed donated
+pool, a flaky upstream API) are impossible to reproduce on demand. This
+module gives every recovery path a deterministic trigger: named
+injection points sit at the seams the failure-domain design cares about,
+and an armed fault raises a typed exception (utils.errors taxonomy)
+exactly ``count`` times, then disarms.
+
+Arming:
+- test API: ``FAULTS.arm("delivery.detok", "request", count=1,
+  match=lambda ctx: ...)`` — ``match`` filters on the call-site context
+  (e.g. the victim sequence), so a test can doom one request out of a
+  concurrent batch with no race against the scheduler thread.
+- env: ``FEI_TPU_FAULT="point:kind:count"`` (comma-separated for
+  several), parsed at import — the chaos pipeline stages sweep this
+  across fresh pytest processes.
+
+Points (the lint-style registry below is the source of truth):
+- ``admission.prefill``  — before a prefill/chunk dispatch
+- ``decode.dispatch``    — before a batched decode dispatch
+- ``grammar.compile``    — before the tool-grammar compile
+- ``provider.http``      — before each remote HTTP attempt
+- ``delivery.detok``     — per-token delivery (grammar walk/emission)
+
+Kinds map to exception types: ``request`` → RequestError, ``device`` →
+DeviceError, ``conn`` → urllib URLError, ``http429``/``http503`` →
+urllib HTTPError (with Retry-After: 0 so retry tests stay fast).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Callable
+
+from fei_tpu.utils.errors import DeviceError, EngineError, RequestError
+from fei_tpu.utils.logging import get_logger
+
+log = get_logger("faults")
+
+POINTS = (
+    "admission.prefill",
+    "decode.dispatch",
+    "grammar.compile",
+    "provider.http",
+    "delivery.detok",
+)
+
+KINDS = ("request", "device", "conn", "http429", "http503")
+
+
+def _make_exc(kind: str, point: str) -> BaseException:
+    msg = f"injected {kind} fault at {point}"
+    if kind == "request":
+        return RequestError(msg)
+    if kind == "device":
+        return DeviceError(msg)
+    import io
+    import urllib.error
+    from email.message import Message
+
+    if kind == "conn":
+        return urllib.error.URLError(msg)
+    if kind in ("http429", "http503"):
+        code = 429 if kind == "http429" else 503
+        hdrs = Message()
+        hdrs["Retry-After"] = "0"
+        return urllib.error.HTTPError(
+            "http://faults.invalid", code, msg, hdrs, io.BytesIO(b"")
+        )
+    raise EngineError(f"unknown fault kind {kind!r} (one of {KINDS})")
+
+
+class _Fault:
+    __slots__ = ("kind", "count", "match")
+
+    def __init__(self, kind: str, count: int,
+                 match: Callable[[dict], bool] | None):
+        self.kind = kind
+        self.count = count
+        self.match = match
+
+
+class FaultInjector:
+    """Process-wide registry of armed faults; thread-safe (the scheduler
+    loop, submitter threads, and providers all check points)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._armed: dict[str, _Fault] = {}
+        self._fired: dict[str, int] = {}
+        self.load_env()
+
+    def arm(self, point: str, kind: str = "request", count: int = 1,
+            match: Callable[[dict], bool] | None = None) -> None:
+        if point not in POINTS:
+            raise EngineError(
+                f"unknown fault point {point!r} (one of {POINTS})"
+            )
+        _make_exc(kind, point)  # validate the kind eagerly
+        with self._lock:
+            self._armed[point] = _Fault(kind, max(1, int(count)), match)
+
+    def disarm(self, point: str | None = None) -> None:
+        with self._lock:
+            if point is None:
+                self._armed.clear()
+                self._fired.clear()
+            else:
+                self._armed.pop(point, None)
+
+    def load_env(self) -> None:
+        """(Re)parse FEI_TPU_FAULT — ``point:kind:count`` specs, comma-
+        separated. Called at import; tests that monkeypatch the env call
+        it explicitly."""
+        spec = os.environ.get("FEI_TPU_FAULT", "").strip()
+        if not spec:
+            return
+        for part in spec.split(","):
+            fields = part.strip().split(":")
+            if len(fields) < 2:
+                log.warning("malformed FEI_TPU_FAULT entry %r", part)
+                continue
+            point, kind = fields[0], fields[1]
+            count = int(fields[2]) if len(fields) > 2 else 1
+            try:
+                self.arm(point, kind, count)
+                log.info("fault armed from env: %s:%s:%d", point, kind, count)
+            except EngineError as exc:
+                log.warning("FEI_TPU_FAULT entry %r rejected: %s", part, exc)
+
+    def check(self, point: str, **ctx) -> None:
+        """Raise the armed fault for ``point`` (if any). A non-matching
+        context does not consume the count, so a fault targeted at one
+        request fires exactly on its victim."""
+        with self._lock:
+            fault = self._armed.get(point)
+            if fault is None:
+                return
+            if fault.match is not None and not fault.match(ctx):
+                return
+            fault.count -= 1
+            if fault.count <= 0:
+                self._armed.pop(point, None)
+            self._fired[point] = self._fired.get(point, 0) + 1
+            kind = fault.kind
+        log.warning("firing injected %s fault at %s", kind, point)
+        raise _make_exc(kind, point)
+
+    def fired(self, point: str) -> int:
+        """How many times ``point`` has fired since the last full
+        disarm() — test assertion helper."""
+        with self._lock:
+            return self._fired.get(point, 0)
+
+
+FAULTS = FaultInjector()

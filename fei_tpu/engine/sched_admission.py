@@ -19,9 +19,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from fei_tpu.engine.faults import FAULTS
 from fei_tpu.engine.sampling import sample_logits
 from fei_tpu.models.llama import KVCache, forward
-from fei_tpu.utils.errors import EngineError
+from fei_tpu.utils.errors import DeadlineExceededError, DeviceError, EngineError
 from fei_tpu.utils.logging import get_logger
 from fei_tpu.utils.metrics import METRICS
 
@@ -48,15 +49,11 @@ class AdmissionMixin:
             try:
                 self._admit_chunk()
             except BaseException as exc:  # noqa: BLE001
-                self._admitting = None
-                self.engine._allocator.free(slot)
-                self._slots[slot] = None
-                seq.finished = True
-                self._trace_finish(seq, "failed")
-                seq.out.put(exc)
+                self._abort_admission(seq, slot, exc)
             return
         while True:
             with self._lock:
+                self._shed_expired_locked()
                 if not self._waiting:
                     return
                 free = [b for b, s in enumerate(self._slots) if s is None]
@@ -91,6 +88,10 @@ class AdmissionMixin:
                     self._prefix.evict_for(need)
                 if need > alloc.free_pages:
                     METRICS.incr("scheduler.admission_blocked")
+                    # refresh saturation gauges HERE: while the pool is
+                    # pinned full nothing finishes, so /metrics would
+                    # otherwise show the last healthy snapshot
+                    self._update_sched_gauges()
                     if prefix:
                         alloc.drop_ref(prefix)
                         # the pin is gone: a page of the memoized match can
@@ -143,15 +144,47 @@ class AdmissionMixin:
                     return  # one chunked admission at a time
                 self._admit(seq, slot)
             except BaseException as exc:  # noqa: BLE001
-                self._admitting = None
-                self.engine._allocator.free(slot)
-                self._slots[slot] = None
-                seq.finished = True
-                self._trace_finish(seq, "failed")
-                seq.out.put(exc)
+                self._abort_admission(seq, slot, exc)
+
+
+    def _shed_expired_locked(self) -> None:
+        """Drop queued requests whose wait already blew their deadline —
+        they must never occupy a slot. Runs under self._lock."""
+        if not any(s.deadline for s in self._waiting):
+            return
+        now = time.perf_counter()
+        expired = [
+            s for s in self._waiting if s.deadline and now > s.deadline
+        ]
+        for s in expired:
+            self._waiting.remove(s)
+            s.finished = True
+            self._trace_finish(s, "deadline_exceeded")
+            METRICS.incr("scheduler.requests_shed")
+            s.out.put(DeadlineExceededError(
+                f"request {s.rid} spent its whole "
+                f"{s.deadline - s.t_queued:.1f}s deadline queued"
+            ))
+
+
+    def _abort_admission(self, seq: _Seq, slot: int, exc: BaseException) -> None:
+        """Admission failed for ONE request: release the slot and fail only
+        that sequence — unless the failure is device-scoped (typed
+        DeviceError, or the donated pool actually consumed), which must
+        escalate to the loop's _fail_all classification."""
+        if isinstance(exc, DeviceError) or not self._pool_intact():
+            raise exc
+        self._admitting = None
+        self.engine._allocator.free(slot)
+        self._slots[slot] = None
+        seq.finished = True
+        self._trace_finish(seq, "failed")
+        METRICS.incr("scheduler.requests_failed_isolated")
+        seq.out.put(exc)
 
 
     def _admit(self, seq: _Seq, slot: int) -> None:
+        FAULTS.check("admission.prefill", seq=seq, rid=seq.rid)
         eng = self.engine
         cfg = eng.cfg
         alloc = eng._allocator
@@ -280,6 +313,7 @@ class AdmissionMixin:
             self._admitting = None
             self._finish(seq)
             return
+        FAULTS.check("admission.prefill", seq=seq, rid=seq.rid)
         eng = self.engine
         C = self.prefill_chunk
         prompt = seq.prompt_ids

@@ -14,6 +14,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from fei_tpu.engine.faults import FAULTS
 from fei_tpu.engine.sampling import sample_logits_dynamic
 from fei_tpu.models.llama import forward_paged
 from fei_tpu.utils.logging import get_logger
@@ -166,8 +167,7 @@ class DecodeMixin:
             try:
                 m = self._host_mask(s)
             except BaseException as exc:  # noqa: BLE001
-                s.out.put(exc)
-                self._finish(s)
+                self._fail_seq(s, exc)
                 continue
             if m is not None:
                 masks[b] = m
@@ -332,6 +332,7 @@ class DecodeMixin:
         in ``self._step_keys`` ([n, B, 2], stays on device) so a
         free-phase trigger rollback can restore a slot's exact mid-scan
         key state."""
+        FAULTS.check("decode.dispatch")
         eng = self.engine
         B = self.B
         tokens = np.zeros((B, 1), dtype=np.int32)

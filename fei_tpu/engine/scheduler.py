@@ -46,11 +46,18 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from fei_tpu.engine.faults import FAULTS
 from fei_tpu.engine.sched_admission import AdmissionMixin
 from fei_tpu.engine.sched_constrain import ConstraintMixin
 from fei_tpu.engine.sched_decode import DecodeMixin
 from fei_tpu.obs.trace import TRACES
-from fei_tpu.utils.errors import EngineError
+from fei_tpu.utils.errors import (
+    DeadlineExceededError,
+    DeviceError,
+    EngineDegradedError,
+    EngineError,
+    QueueFullError,
+)
 from fei_tpu.utils.logging import get_logger
 from fei_tpu.utils.metrics import METRICS
 
@@ -99,6 +106,9 @@ class _Seq:
     rid: str = ""
     trace: object | None = None
     t_queued: float = 0.0
+    # absolute perf_counter deadline (0 = none): expired-while-queued
+    # requests shed at admission, decoding ones cancel at the reap sweep
+    deadline: float = 0.0
 
 
 class PagedScheduler(AdmissionMixin, DecodeMixin, ConstraintMixin):
@@ -180,6 +190,31 @@ class PagedScheduler(AdmissionMixin, DecodeMixin, ConstraintMixin):
         self.multistep = max(
             1, int(_os.environ.get("FEI_TPU_SCHED_MULTISTEP", "8"))
         )
+        # backpressure: bound the waiting queue (0 = unbounded) and shed
+        # over-limit submits with a typed QueueFullError the server maps
+        # to HTTP 429 + Retry-After instead of queueing unboundedly
+        self.max_queue = int(_os.environ.get("FEI_TPU_MAX_QUEUE", "0"))
+        self.retry_after_s = float(
+            _os.environ.get("FEI_TPU_RETRY_AFTER_S", "1")
+        )
+        # per-request wall-clock deadline default (0 = none); a request
+        # may override via GenerationConfig.deadline_s
+        self.default_deadline_s = float(
+            _os.environ.get("FEI_TPU_DEFAULT_DEADLINE_S", "0")
+        )
+        # crash-loop breaker: breaker_fails device failures (_fail_all)
+        # inside breaker_window_s trip the engine into a degraded state
+        # that rejects new submits for breaker_cooldown_s — rebuilding
+        # the pool per doomed request would just thrash HBM
+        self.breaker_fails = int(_os.environ.get("FEI_TPU_BREAKER_FAILS", "3"))
+        self.breaker_window_s = float(
+            _os.environ.get("FEI_TPU_BREAKER_WINDOW_S", "60")
+        )
+        self.breaker_cooldown_s = float(
+            _os.environ.get("FEI_TPU_BREAKER_COOLDOWN_S", "30")
+        )
+        self._fail_times: deque[float] = deque()
+        self._degraded_until = 0.0
         self._pchunk_jit: dict = {}
         self._arm_jit = None
         self._closed = False
@@ -239,6 +274,28 @@ class PagedScheduler(AdmissionMixin, DecodeMixin, ConstraintMixin):
         freely until the trigger text appears, then constrains (the agent
         tool-call protocol); without it the whole output is constrained."""
         eng = self.engine
+        if self.degraded():
+            METRICS.incr("scheduler.requests_shed")
+            raise EngineDegradedError(
+                f"engine degraded: {len(self._fail_times)} device failures "
+                f"within {self.breaker_window_s:.0f}s tripped the crash-loop "
+                "breaker; retry after the cooldown or call reset_degraded()",
+                retry_after_s=max(
+                    self.retry_after_s,
+                    self._degraded_until - time.monotonic(),
+                ),
+            )
+        if self.max_queue:
+            with self._lock:
+                depth = len(self._waiting)
+            if depth >= self.max_queue:
+                METRICS.incr("scheduler.requests_shed")
+                METRICS.gauge("scheduler.queue_depth", depth)
+                raise QueueFullError(
+                    f"waiting queue is full ({depth} >= FEI_TPU_MAX_QUEUE="
+                    f"{self.max_queue})",
+                    retry_after_s=self.retry_after_s,
+                )
         n = len(prompt_ids)
         if n > eng.max_seq_len:
             raise EngineError(
@@ -262,6 +319,9 @@ class PagedScheduler(AdmissionMixin, DecodeMixin, ConstraintMixin):
             budget=budget,
         )
         seq.t_queued = time.perf_counter()
+        dl = getattr(gen, "deadline_s", 0.0) or self.default_deadline_s
+        if dl > 0:
+            seq.deadline = seq.t_queued + dl
         seq.trace = TRACES.start(prompt_tokens=n)
         seq.rid = seq.trace.rid
         METRICS.incr("scheduler.requests_submitted")
@@ -321,9 +381,25 @@ class PagedScheduler(AdmissionMixin, DecodeMixin, ConstraintMixin):
                 self._closed = False  # a submit after close() reopens
                 self._waiting.append(seq)
                 self._start_thread()
-        METRICS.gauge("scheduler.queue_depth", len(self._waiting))
+        # full gauge refresh on submit (not just queue depth): /metrics
+        # must reflect pool saturation even while nothing is finishing
+        self._update_sched_gauges()
         self._wake.set()
         return seq
+
+    def degraded(self) -> bool:
+        """True while the crash-loop breaker holds submits rejected; the
+        cooldown expiring clears the state lazily."""
+        if self._degraded_until and time.monotonic() >= self._degraded_until:
+            self.reset_degraded()
+        return bool(self._degraded_until)
+
+    def reset_degraded(self) -> None:
+        """Operator override: clear the breaker without waiting out the
+        cooldown (the next submit rebuilds the pool as usual)."""
+        self._degraded_until = 0.0
+        self._fail_times.clear()
+        METRICS.gauge("engine.degraded", 0)
 
     def cancel(self, seq: _Seq) -> None:
         with self._lock:
@@ -404,11 +480,34 @@ class PagedScheduler(AdmissionMixin, DecodeMixin, ConstraintMixin):
                 self._step_active()
             except BaseException as exc:  # noqa: BLE001
                 log.error("scheduler loop error: %r", exc)
-                self._fail_all(exc)
+                if isinstance(exc, DeviceError) or not self._pool_intact():
+                    # device domain: the donated pool is (or must be
+                    # presumed) consumed — drop and rebuild it
+                    self._fail_all(exc)
+                else:
+                    # host-side failure that escaped the per-request
+                    # handlers: the pool is healthy but the offender is
+                    # unattributable, so fail the in-flight set while the
+                    # pool and prefix cache survive (close/drain path)
+                    self._drain(exc)
 
     def _reap_cancelled(self) -> None:
+        now = time.perf_counter()
         for b, s in enumerate(self._slots):
-            if s is not None and s.cancelled and not s.finished:
+            if s is None or s.finished:
+                continue
+            if s.cancelled:
+                self._finish(s)
+            elif s.deadline and now > s.deadline:
+                # mid-decode deadline: same eviction path as a cancel —
+                # slot freed through the healthy pool, typed error to the
+                # waiter, `deadline_exceeded` in the trace (which also
+                # increments scheduler.requests_deadline_exceeded)
+                s.out.put(DeadlineExceededError(
+                    f"request {s.rid} exceeded its "
+                    f"{s.deadline - s.t_queued:.1f}s deadline mid-decode"
+                ))
+                self._trace_finish(s, "deadline_exceeded")
                 self._finish(s)
 
     def _slot_row(self, slot: int) -> np.ndarray:
@@ -422,7 +521,35 @@ class PagedScheduler(AdmissionMixin, DecodeMixin, ConstraintMixin):
     def _deliver(self, seq: _Seq, t: int) -> None:
         """Handle one sampled token for an armed sequence — grammar walk,
         stop handling, emission, completion. Shared by the admission first
-        token and every decode step."""
+        token and every decode step.
+
+        Delivery is a request-scoped failure domain: the grammar/scanner
+        walk, the fallback masker advance, and emission are all host-side
+        per-request work, so an exception here fails ONLY this sequence
+        (healthy-pool eviction via _fail_seq) while every other slot keeps
+        decoding through the next scan. Device-scoped failures (typed
+        DeviceError, or the donated pool actually consumed) re-raise to
+        the loop's _fail_all classification."""
+        try:
+            FAULTS.check("delivery.detok", seq=seq, rid=seq.rid)
+            self._deliver_inner(seq, t)
+        except BaseException as exc:  # noqa: BLE001
+            if isinstance(exc, DeviceError) or not self._pool_intact():
+                raise
+            log.warning("request %s failed at delivery: %r", seq.rid, exc)
+            self._fail_seq(seq, exc)
+
+    def _fail_seq(self, seq: _Seq, exc: BaseException) -> None:
+        """Fail ONE request: typed error to its waiter, `failed` trace,
+        slot evicted through the same healthy-pool path as a normal
+        completion — the pool, prefix cache, and every other stream
+        survive."""
+        seq.out.put(exc)
+        self._trace_finish(seq, "failed")
+        METRICS.incr("scheduler.requests_failed_isolated")
+        self._finish(seq)
+
+    def _deliver_inner(self, seq: _Seq, t: int) -> None:
         if seq.grammar is not None:
             emit, done = self._grammar_advance(seq, t)
         else:
@@ -550,7 +677,27 @@ class PagedScheduler(AdmissionMixin, DecodeMixin, ConstraintMixin):
     def _fail_all(self, exc: BaseException) -> None:
         """A device failure mid-step leaves the donated pool unusable: drop
         it (recreated on next admission) instead of persisting dead arrays
-        (round-1 advisory on _release_paged)."""
+        (round-1 advisory on _release_paged). Each call records into the
+        crash-loop breaker: ``breaker_fails`` device failures within
+        ``breaker_window_s`` put the engine in a degraded state that
+        rejects new submits (EngineDegradedError) for
+        ``breaker_cooldown_s`` instead of thrashing pool rebuilds."""
+        now = time.monotonic()
+        self._fail_times.append(now)
+        while (
+            self._fail_times
+            and now - self._fail_times[0] > self.breaker_window_s
+        ):
+            self._fail_times.popleft()
+        if len(self._fail_times) >= self.breaker_fails:
+            self._degraded_until = now + self.breaker_cooldown_s
+            METRICS.gauge("engine.degraded", 1)
+            log.error(
+                "crash-loop breaker tripped: %d device failures within "
+                "%.0fs; rejecting submits for %.0fs",
+                len(self._fail_times), self.breaker_window_s,
+                self.breaker_cooldown_s,
+            )
         with self._lock:
             doomed = [s for s in self._slots if s is not None] + list(self._waiting)
             self._waiting.clear()

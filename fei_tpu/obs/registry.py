@@ -27,6 +27,17 @@ METRIC_REGISTRY: dict[str, tuple[str, str]] = {
     "scheduler.requests_cancelled": ("counter", "Sequences cancelled."),
     "scheduler.requests_failed": ("counter",
                                   "Sequences failed with an error."),
+    "scheduler.requests_failed_isolated": (
+        "counter", "Request-scoped failures contained to one sequence "
+                   "(slot evicted via the healthy-pool path; other "
+                   "streams unaffected)."),
+    "scheduler.requests_shed": (
+        "counter", "Requests rejected by backpressure: waiting queue at "
+                   "FEI_TPU_MAX_QUEUE, degraded-state rejections, or "
+                   "deadline already expired while queued."),
+    "scheduler.requests_deadline_exceeded": (
+        "counter", "Sequences that hit their deadline (shed at admission "
+                   "or cancelled mid-decode)."),
     "scheduler.admission_blocked": ("counter",
                                     "Admissions deferred by page-pool "
                                     "pressure."),
@@ -81,6 +92,9 @@ METRIC_REGISTRY: dict[str, tuple[str, str]] = {
     "prefix.misses": ("counter", "Prefix-cache misses on admission."),
     "prefix.evictions": ("counter", "Prefix-cache entries evicted."),
     "server.requests": ("counter", "HTTP requests handled by the API core."),
+    "provider.retries": ("counter",
+                         "Remote provider HTTP attempts retried "
+                         "(connection errors and 429/5xx)."),
     "server.profile_captures": ("counter",
                                 "On-demand jax.profiler captures taken."),
     # --- gauges ---------------------------------------------------------
@@ -89,6 +103,9 @@ METRIC_REGISTRY: dict[str, tuple[str, str]] = {
                           "Decode throughput of the most recent "
                           "generation (tok/s)."),
     "scheduler.queue_depth": ("gauge", "Sequences waiting for admission."),
+    "engine.degraded": ("gauge",
+                        "1 while the crash-loop breaker holds the engine "
+                        "degraded (submits rejected), else 0."),
     "scheduler.running_slots": ("gauge", "Sequences actively decoding."),
     "scheduler.batch_slots_active": ("gauge",
                                      "Active slots in the last decode "

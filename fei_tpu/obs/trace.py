@@ -2,7 +2,7 @@
 
 Every submitted sequence gets a request id and an ordered list of phase
 events — queued → admitted → prefill → first_token → completed/cancelled/
-failed — kept in a bounded ring buffer (``FEI_TPU_TRACE_RING``, default
+failed/deadline_exceeded — kept in a bounded ring buffer (``FEI_TPU_TRACE_RING``, default
 256) and served by ``GET /v1/traces`` on ui/server.py. Setting
 ``FEI_TPU_TRACE_FILE`` additionally appends each finished trace as one
 JSONL line, the flight-recorder shape production schedulers use to debug
@@ -23,7 +23,7 @@ import uuid
 from collections import deque
 from dataclasses import dataclass, field
 
-TERMINAL_PHASES = ("completed", "cancelled", "failed")
+TERMINAL_PHASES = ("completed", "cancelled", "failed", "deadline_exceeded")
 
 
 @dataclass

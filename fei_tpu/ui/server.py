@@ -27,6 +27,11 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlsplit
 
 from fei_tpu.obs.trace import TRACES
+from fei_tpu.utils.errors import (
+    DeadlineExceededError,
+    EngineDegradedError,
+    QueueFullError,
+)
 from fei_tpu.utils.logging import get_logger
 from fei_tpu.utils.metrics import METRICS
 
@@ -111,6 +116,8 @@ def _gen_overrides(body: dict) -> dict:
         over["min_p"] = min(max(float(body["min_p"]), 0.0), 1.0)
     if body.get("seed") is not None:
         over["seed"] = int(body["seed"])
+    if body.get("deadline_s") is not None:  # non-OpenAI extension
+        over["deadline_s"] = max(0.0, float(body["deadline_s"]))
     return over
 
 
@@ -182,13 +189,18 @@ class ServeAPI:
     # -- non-streaming ------------------------------------------------------
 
     def handle(self, method: str, path: str, body: dict,
-               headers: dict) -> tuple[int, dict | str]:
-        """Route a request. A ``str`` payload means plain text (the
-        Prometheus exposition); dicts serialize as JSON."""
+               headers: dict) -> tuple:
+        """Route a request. Returns ``(status, payload)`` or ``(status,
+        payload, extra_headers)``. A ``str`` payload means plain text
+        (the Prometheus exposition); dicts serialize as JSON."""
         parts = urlsplit(path)
         route, query = parts.path, parse_qs(parts.query)
         METRICS.incr("server.requests")
         if route == "/health":
+            if self._degraded():
+                # surface the crash-loop breaker so load balancers eject
+                # the replica instead of feeding it doomed requests
+                return 503, {"status": "degraded", "model": self.model_name}
             return 200, {"status": "ok", "model": self.model_name}
         if route == "/metrics" and method == "GET":
             # pre-auth like /health: scrapers don't carry bearer tokens
@@ -275,7 +287,20 @@ class ServeAPI:
             **self._overrides_kw(body),
         }
 
-    def _chat(self, body: dict) -> tuple[int, dict]:
+    def _degraded(self) -> bool:
+        """True when the backing engine's crash-loop breaker is holding
+        the scheduler degraded (non-engine providers: never)."""
+        eng = getattr(self.provider, "engine", None)
+        sched = getattr(eng, "_scheduler", None)
+        return sched is not None and sched.degraded()
+
+    @staticmethod
+    def _retry_after(exc) -> dict:
+        return {"Retry-After": str(max(1, round(
+            getattr(exc, "retry_after_s", 1.0)
+        )))}
+
+    def _chat(self, body: dict) -> tuple:
         try:
             kw = self._parse_request(body)
         except (ValueError, KeyError, TypeError) as exc:
@@ -284,6 +309,19 @@ class ServeAPI:
         try:
             msgs = kw.pop("messages")
             resp = self.provider.complete(msgs, **kw)
+        except QueueFullError as exc:
+            # backpressure, not failure: the waiting queue is at
+            # FEI_TPU_MAX_QUEUE — tell the client when to come back
+            return 429, {"error": {"message": str(exc),
+                                   "type": "overloaded_error"}}, \
+                self._retry_after(exc)
+        except EngineDegradedError as exc:
+            return 503, {"error": {"message": str(exc),
+                                   "type": "overloaded_error"}}, \
+                self._retry_after(exc)
+        except DeadlineExceededError as exc:
+            return 504, {"error": {"message": str(exc),
+                                   "type": "timeout_error"}}
         except Exception as exc:  # noqa: BLE001 — surface as JSON, not a
             # dropped socket (EngineError/ProviderError/anything)
             log.warning("completion failed: %r", exc)
@@ -341,9 +379,17 @@ class ServeAPI:
                     break
         except Exception as exc:  # noqa: BLE001
             log.warning("stream failed: %r", exc)
+            # SSE headers are already committed, so saturation/deadline
+            # errors can't change the status line — but the frame keeps
+            # the typed category so clients can still back off
+            etype = "server_error"
+            if isinstance(exc, (QueueFullError, EngineDegradedError)):
+                etype = "overloaded_error"
+            elif isinstance(exc, DeadlineExceededError):
+                etype = "timeout_error"
             yield (b"data: " + json.dumps({"error": {
                 "message": f"{type(exc).__name__}: {exc}",
-                "type": "server_error",
+                "type": etype,
             }}).encode() + b"\n\n")
             yield b"data: [DONE]\n\n"
             return
@@ -371,7 +417,8 @@ def make_handler(api: ServeAPI):
         def log_message(self, fmt, *args):  # route through our logger
             log.debug("http: " + fmt, *args)
 
-        def _json(self, status: int, payload: dict | str) -> None:
+        def _json(self, status: int, payload: dict | str,
+                  headers: dict | None = None) -> None:
             if isinstance(payload, str):  # Prometheus text exposition
                 data = payload.encode("utf-8")
                 ctype = "text/plain; version=0.0.4; charset=utf-8"
@@ -381,6 +428,8 @@ def make_handler(api: ServeAPI):
             self.send_response(status)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(data)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(data)
 
@@ -395,10 +444,8 @@ def make_handler(api: ServeAPI):
                 return None
 
         def do_GET(self):  # noqa: N802
-            status, payload = api.handle(
-                "GET", self.path, {}, dict(self.headers)
-            )
-            self._json(status, payload)
+            res = api.handle("GET", self.path, {}, dict(self.headers))
+            self._json(res[0], res[1], res[2] if len(res) > 2 else None)
 
         def do_POST(self):  # noqa: N802
             body = self._body()
@@ -431,10 +478,8 @@ def make_handler(api: ServeAPI):
                 except (BrokenPipeError, ConnectionResetError):
                     log.info("client disconnected mid-stream")
                 return
-            status, payload = api.handle(
-                "POST", self.path, body, dict(self.headers)
-            )
-            self._json(status, payload)
+            res = api.handle("POST", self.path, body, dict(self.headers))
+            self._json(res[0], res[1], res[2] if len(res) > 2 else None)
 
     return Handler
 

@@ -37,6 +37,49 @@ class EngineError(FeiError):
     """TPU inference engine failure (compile, OOM, shape mismatch)."""
 
 
+class RequestError(EngineError):
+    """Host-side failure scoped to ONE request (bad grammar table,
+    tokenizer edge case, a user callback that raised). The scheduler
+    fails only the offending sequence — its slot evicts through the
+    healthy-pool path and every other stream keeps decoding."""
+
+
+class DeviceError(EngineError):
+    """Device-scoped failure: the donated KV pool must be presumed
+    consumed (mid-execution dispatch failure). Routes to the
+    scheduler's ``_fail_all`` — pool dropped and rebuilt on the next
+    admission; every in-flight request fails."""
+
+
+class QueueFullError(RequestError):
+    """Backpressure: the scheduler's waiting queue is at
+    ``FEI_TPU_MAX_QUEUE``. The server maps this to HTTP 429 with a
+    ``Retry-After`` hint (``retry_after_s``)."""
+
+    def __init__(self, message: str, *, retry_after_s: float = 1.0,
+                 cause: Exception | None = None):
+        super().__init__(message, cause=cause)
+        self.retry_after_s = retry_after_s
+
+
+class EngineDegradedError(EngineError):
+    """The crash-loop breaker tripped: N device failures inside the
+    breaker window. New submits are rejected (HTTP 503 with
+    ``Retry-After``) until the cooldown elapses or the operator calls
+    ``scheduler.reset_degraded()`` — rebuilding the pool on every
+    doomed request would just thrash HBM."""
+
+    def __init__(self, message: str, *, retry_after_s: float = 1.0,
+                 cause: Exception | None = None):
+        super().__init__(message, cause=cause)
+        self.retry_after_s = retry_after_s
+
+
+class DeadlineExceededError(RequestError):
+    """The request's deadline expired — shed at admission (queue wait
+    alone blew the budget) or cancelled mid-decode at delivery."""
+
+
 class CheckpointError(EngineError):
     """Weight loading / checkpoint save-restore failure."""
 

@@ -90,6 +90,25 @@ stage kernels env FEI_TPU_TEST_PLATFORM=tpu python -m pytest \
 stage flash_grad env FEI_TPU_TEST_PLATFORM=tpu python -m pytest \
   tests/test_flash_in_model.py -q --timeout 180
 
+# 0c. failure domains on-chip: request-scoped isolation, the breaker,
+# and deadline/backpressure shedding against REAL device dispatches
+# (the hermetic suite only ever proves them over the CPU backend), then
+# FEI_TPU_FAULT sweeps of the recovery proof in fresh processes — one
+# per fault domain the design distinguishes (docs/ENGINE.md)
+stage faults env FEI_TPU_TEST_PLATFORM=tpu python -m pytest \
+  tests/test_faults.py -q --timeout 300
+stage chaos_device env FEI_TPU_TEST_PLATFORM=tpu \
+  FEI_TPU_FAULT="decode.dispatch:device:1" python -m pytest \
+  tests/test_faults.py::test_env_fault_sweep_recovers -q --timeout 300
+stage chaos_request env FEI_TPU_TEST_PLATFORM=tpu \
+  FEI_TPU_FAULT="delivery.detok:request:2,admission.prefill:request:1" \
+  python -m pytest tests/test_faults.py::test_env_fault_sweep_recovers -q \
+  --timeout 300
+stage chaos_crashloop env FEI_TPU_TEST_PLATFORM=tpu \
+  FEI_TPU_FAULT="decode.dispatch:device:3" FEI_TPU_BREAKER_FAILS=2 \
+  FEI_TPU_BREAKER_WINDOW_S=60 python -m pytest \
+  tests/test_faults.py::test_env_fault_sweep_recovers -q --timeout 300
+
 # ---- TIER 1: the gate + everything never measured on-chip (r3 stages 6b-9
 # plus the r4 additions). Run these while the window is young. ----
 

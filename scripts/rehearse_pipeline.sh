@@ -122,6 +122,24 @@ stage bench_paged_kv8 --json -- env FEI_TPU_BENCH_SUITE=paged \
 stage bench_moe --json -- env FEI_TPU_BENCH_SUITE=moe \
   FEI_TPU_BENCH_MODEL=tiny-moe python -u bench.py
 
+# --- chaos stages: every recovery path under deterministic injected
+# faults (engine/faults.py). The fault suite runs FOR REAL here (it is
+# cheap and hermetic); the FEI_TPU_FAULT sweep then re-runs the recovery
+# proof in fresh processes with env-armed faults at each point/kind the
+# failure-domain design distinguishes (docs/ENGINE.md). ----
+stage faults -- python -m pytest tests/test_faults.py -q --timeout 300
+stage chaos_device -- env FEI_TPU_FAULT="decode.dispatch:device:1" \
+  python -m pytest tests/test_faults.py::test_env_fault_sweep_recovers -q \
+  --timeout 300
+stage chaos_request -- env \
+  FEI_TPU_FAULT="delivery.detok:request:2,admission.prefill:request:1" \
+  python -m pytest tests/test_faults.py::test_env_fault_sweep_recovers -q \
+  --timeout 300
+stage chaos_crashloop -- env FEI_TPU_FAULT="decode.dispatch:device:3" \
+  FEI_TPU_BREAKER_FAILS=2 FEI_TPU_BREAKER_WINDOW_S=60 \
+  python -m pytest tests/test_faults.py::test_env_fault_sweep_recovers -q \
+  --timeout 300
+
 echo
 echo "=== rehearsal results ==="
 for r in "${RESULTS[@]}"; do echo "$r"; done
