@@ -117,3 +117,70 @@ def restore_checkpoint(
         return _retype_qtensors(mgr.restore(step))
     finally:
         mgr.close()
+
+
+# -- drain-time request snapshots --------------------------------------------
+#
+# Graceful drain persists the still-queued (and preempt-snapshotted) request
+# set so a warm restart loses zero accepted requests. These are host-side
+# token lists + sampling knobs — plain JSON, not device pytrees, so they do
+# not go through orbax: the schema must stay readable by operators and by a
+# differently-built binary after a deploy.
+
+_SNAPSHOT_FILE = "requests.json"
+_SNAPSHOT_VERSION = 1
+
+
+def save_request_snapshots(directory: str, snaps: list[dict]) -> None:
+    """Atomically persist drain-time request snapshots (tmp + rename, the
+    same torn-write discipline as the pipeline reports)."""
+    import json
+
+    os.makedirs(directory, exist_ok=True)
+    path = os.path.join(directory, _SNAPSHOT_FILE)
+    tmp = path + ".tmp"
+    try:
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump({"version": _SNAPSHOT_VERSION, "requests": snaps}, f)
+        os.replace(tmp, path)
+    except OSError as exc:
+        raise CheckpointError(
+            f"could not persist request snapshots to {path}: {exc}",
+            cause=exc,
+        )
+    log.info("saved %d request snapshots -> %s", len(snaps), path)
+
+
+def load_request_snapshots(directory: str) -> list[dict]:
+    """Load persisted request snapshots; [] when none were saved. A
+    corrupt or future-versioned file raises CheckpointError — silently
+    dropping accepted requests is the failure mode this exists to
+    prevent."""
+    import json
+
+    path = os.path.join(directory, _SNAPSHOT_FILE)
+    if not os.path.exists(path):
+        return []
+    try:
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+    except (OSError, json.JSONDecodeError) as exc:
+        raise CheckpointError(
+            f"could not read request snapshots from {path}: {exc}",
+            cause=exc,
+        )
+    if data.get("version") != _SNAPSHOT_VERSION:
+        raise CheckpointError(
+            f"request snapshot version {data.get('version')!r} in {path} "
+            f"is not the supported version {_SNAPSHOT_VERSION}"
+        )
+    return list(data.get("requests", []))
+
+
+def clear_request_snapshots(directory: str) -> None:
+    """Remove the snapshot file (after a successful warm-restart replay:
+    at-most-once re-admission)."""
+    try:
+        os.remove(os.path.join(directory, _SNAPSHOT_FILE))
+    except FileNotFoundError:
+        pass

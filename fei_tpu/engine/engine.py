@@ -532,6 +532,46 @@ class InferenceEngine:
         if self._scheduler is not None:
             self._scheduler.close()
 
+    def begin_drain(
+        self, deadline_s: float | None = None,
+        snapshot_dir: str | None = None,
+    ) -> None:
+        """Graceful drain (SIGTERM / POST /drain): reject new submits with
+        EngineDrainingError, let in-flight requests finish within the
+        deadline, snapshot the rest for warm restart. Delegates to the
+        scheduler; a dense-only engine has nothing in flight to drain."""
+        if self._scheduler is not None:
+            self._scheduler.begin_drain(
+                deadline_s=deadline_s, snapshot_dir=snapshot_dir
+            )
+
+    def wait_drained(self, timeout: float | None = None) -> bool:
+        """Block until an initiated drain finalizes. True when it
+        completed within ``timeout`` (trivially true when no scheduler
+        exists)."""
+        if self._scheduler is None:
+            return True
+        return self._scheduler.wait_drained(timeout)
+
+    def warm_restart(self, snapshot_dir: str) -> list:
+        """Re-admit the request snapshots a previous process persisted at
+        drain. Each resumes byte-identically (re-prefill + saved PRNG key)
+        and replays its already-delivered tokens to the fresh consumer.
+        The snapshot file clears BEFORE re-admission (at-most-once: a
+        crash mid-replay must not double-serve on the next boot). Returns
+        the resubmitted sequence handles (stream each via
+        ``scheduler.drain(seq)``)."""
+        from fei_tpu.engine.checkpoint import (
+            clear_request_snapshots,
+            load_request_snapshots,
+        )
+
+        snaps = load_request_snapshots(snapshot_dir)
+        if not snaps:
+            return []
+        clear_request_snapshots(snapshot_dir)
+        return self.scheduler.restore_snapshots(snaps)
+
     @property
     def scheduler(self):
         """The continuous-batching scheduler; all paged generation —

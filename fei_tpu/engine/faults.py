@@ -23,10 +23,16 @@ Points (the lint-style registry below is the source of truth):
 - ``grammar.compile``    — before the tool-grammar compile
 - ``provider.http``      — before each remote HTTP attempt
 - ``delivery.detok``     — per-token delivery (grammar walk/emission)
+- ``pool.alloc``         — inside the scheduler's page-allocation seam
 
 Kinds map to exception types: ``request`` → RequestError, ``device`` →
 DeviceError, ``conn`` → urllib URLError, ``http429``/``http503`` →
-urllib HTTPError (with Retry-After: 0 so retry tests stay fast).
+urllib HTTPError (with Retry-After: 0 so retry tests stay fast), and
+``exhausted``/``transient`` → PoolPressure (``pool.alloc`` only: the
+scheduler's pressure handler swallows it like a real exhaustion, so the
+chaos sweep exercises preemption with a full-size pool; ``transient``
+documents a pressure spike that clears on the first retry — the
+injector's count expiring models the clearing).
 """
 
 from __future__ import annotations
@@ -35,7 +41,12 @@ import os
 import threading
 from typing import Callable
 
-from fei_tpu.utils.errors import DeviceError, EngineError, RequestError
+from fei_tpu.utils.errors import (
+    DeviceError,
+    EngineError,
+    PoolPressure,
+    RequestError,
+)
 from fei_tpu.utils.logging import get_logger
 
 log = get_logger("faults")
@@ -46,9 +57,13 @@ POINTS = (
     "grammar.compile",
     "provider.http",
     "delivery.detok",
+    "pool.alloc",
 )
 
-KINDS = ("request", "device", "conn", "http429", "http503")
+KINDS = (
+    "request", "device", "conn", "http429", "http503",
+    "exhausted", "transient",
+)
 
 
 def _make_exc(kind: str, point: str) -> BaseException:
@@ -57,6 +72,8 @@ def _make_exc(kind: str, point: str) -> BaseException:
         return RequestError(msg)
     if kind == "device":
         return DeviceError(msg)
+    if kind in ("exhausted", "transient"):
+        return PoolPressure(msg)
     import io
     import urllib.error
     from email.message import Message

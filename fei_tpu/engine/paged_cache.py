@@ -125,10 +125,21 @@ class PageAllocator:
         self._free = list(range(num_pages - 1, 0, -1))  # pop() yields 1, 2, …
         self._owned: dict[int, list[int]] = {}
         self._refs: dict[int, int] = {}
+        self._refresh_gauges()
 
     @property
     def free_pages(self) -> int:
         return len(self._free)
+
+    def _refresh_gauges(self) -> None:
+        """Pool-pressure gauges refreshed at every alloc/free transition:
+        /metrics must show saturation the moment it happens, not at the
+        next scheduler-side snapshot."""
+        total = self.num_pages - 1  # page 0 is the reserved null page
+        free = len(self._free)
+        METRICS.gauge("pool.pages_total", total)
+        METRICS.gauge("pool.pages_free", free)
+        METRICS.gauge("pool.pages_in_use", total - free)
 
     def refcount(self, page: int) -> int:
         return self._refs.get(page, 0)
@@ -158,7 +169,22 @@ class PageAllocator:
         for p in got:
             self._refs[p] = 1
         self._owned.setdefault(seq_id, []).extend(got)
+        self._refresh_gauges()
         return got
+
+    def try_alloc(
+        self, seq_id: int, n: int, contiguous: bool = False
+    ) -> list[int] | None:
+        """Pressure-returning variant of :meth:`alloc` for the scheduler
+        path: ``None`` on exhaustion (or fragmentation in contiguous
+        mode) with NO partial effects, so the caller can treat pressure
+        as a scheduling event — evict prefix-cache references, preempt a
+        victim, retry — instead of unwinding a half-allocated request."""
+        if n > len(self._free):
+            return None
+        if contiguous and self._find_run(n) is None:
+            return None
+        return self.alloc(seq_id, n, contiguous=contiguous)
 
     def share(self, seq_id: int, pages: list[int]) -> None:
         """Add existing (cached-prefix) pages to a sequence: refcount++
@@ -189,6 +215,8 @@ class PageAllocator:
             if self._refs[p] <= 0:
                 del self._refs[p]
                 self._free.append(p)
+        if pages:
+            self._refresh_gauges()
 
     def _find_run(self, n: int) -> list[int] | None:
         free = sorted(self._free)

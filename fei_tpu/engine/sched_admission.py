@@ -22,7 +22,12 @@ import numpy as np
 from fei_tpu.engine.faults import FAULTS
 from fei_tpu.engine.sampling import sample_logits
 from fei_tpu.models.llama import KVCache, forward
-from fei_tpu.utils.errors import DeadlineExceededError, DeviceError, EngineError
+from fei_tpu.utils.errors import (
+    DeadlineExceededError,
+    DeviceError,
+    EngineError,
+    PoolPressure,
+)
 from fei_tpu.utils.logging import get_logger
 from fei_tpu.utils.metrics import METRICS
 
@@ -61,9 +66,13 @@ class AdmissionMixin:
                     return
                 seq = self._waiting[0]
                 alloc = self.engine._allocator
+                # a preempted sequence re-prefills prompt + generated[:-1]
+                # — its prefix match, page demand, and prefill routing are
+                # all over that extended id list
+                ids = self._prefill_ids(seq)
                 if seq.prefix_match is None:
                     seq.prefix_match = (
-                        self._prefix.match(seq.prompt_ids) if self._prefix else []
+                        self._prefix.match(ids) if self._prefix else []
                     )
                 prefix = seq.prefix_match
                 if prefix:
@@ -75,36 +84,54 @@ class AdmissionMixin:
                     try:
                         alloc.take_ref(prefix)
                     except EngineError:
-                        seq.prefix_match = prefix = self._prefix.match(
-                            seq.prompt_ids
-                        )
+                        seq.prefix_match = prefix = self._prefix.match(ids)
                         if prefix:
                             alloc.take_ref(prefix)
-                need = alloc.pages_needed(
-                    min(len(seq.prompt_ids) + seq.budget, self.engine.max_seq_len)
-                ) - len(prefix)
-                if need > alloc.free_pages and self._prefix is not None:
-                    # registry references are reclaimable capacity
-                    self._prefix.evict_for(need)
-                if need > alloc.free_pages:
-                    METRICS.incr("scheduler.admission_blocked")
-                    # refresh saturation gauges HERE: while the pool is
-                    # pinned full nothing finishes, so /metrics would
-                    # otherwise show the last healthy snapshot
-                    self._update_sched_gauges()
-                    if prefix:
-                        alloc.drop_ref(prefix)
-                        # the pin is gone: a page of the memoized match can
-                        # be recycled before the retry, and take_ref's
-                        # refcount>0 probe cannot tell "same content" from
-                        # "page reused by another sequence" — force the
-                        # retry to re-probe the registry instead
-                        seq.prefix_match = None
-                    return
+                # HYBRID reservation (one pressure-aware path for admission
+                # and decode): try the legacy full worst-case reservation
+                # first — on a roomy pool nothing changes and the sequence
+                # can never stall mid-decode. Under pressure fall back to a
+                # LAZY reservation (prefill + one multi-step scan, grown on
+                # demand by sched_decode._grow_for_steps) with preemption
+                # allowed to make room; only when even that fails does the
+                # request block at the head of the queue.
+                seq.lazy = False
+                full_tokens = min(
+                    len(seq.prompt_ids) + seq.budget, self.engine.max_seq_len
+                )
+                need = alloc.pages_needed(full_tokens) - len(prefix)
+                if not self._ensure_free(seq, need, preempt=False):
+                    lazy_need = max(
+                        0,
+                        alloc.pages_needed(
+                            min(len(ids) + self.multistep + 1, full_tokens)
+                        ) - len(prefix),
+                    )
+                    if self.preempt_policy != "off" and self._ensure_free(
+                        seq, lazy_need, preempt=True
+                    ):
+                        seq.lazy = True
+                    else:
+                        METRICS.incr("scheduler.admission_blocked")
+                        # refresh saturation gauges HERE: while the pool is
+                        # pinned full nothing finishes, so /metrics would
+                        # otherwise show the last healthy snapshot
+                        self._update_sched_gauges()
+                        if prefix:
+                            alloc.drop_ref(prefix)
+                            # the pin is gone: a page of the memoized match
+                            # can be recycled before the retry, and
+                            # take_ref's refcount>0 probe cannot tell "same
+                            # content" from "page reused by another
+                            # sequence" — force the retry to re-probe the
+                            # registry instead
+                            seq.prefix_match = None
+                        return
                 self._waiting.popleft()
                 slot = free[0]
                 self._slots[slot] = seq
                 seq.slot = slot
+                seq.shield = True  # not a victim until one dispatch lands
                 if prefix:
                     alloc.share(slot, prefix)
                     alloc.drop_ref(prefix)  # pin handed over to the seq ref
@@ -124,18 +151,23 @@ class AdmissionMixin:
                 # chunked path keeps its bounded-stall guarantee. Prefix-
                 # cache hits also keep the chunked path: its page gather
                 # already skips recomputing the cached tokens.
-                n_tok = len(seq.prompt_ids)
+                n_tok = len(ids)
                 sp_n = (
                     self.engine.mesh.shape.get("sp", 1)
                     if self.engine.mesh is not None else 1
                 )
                 sp_long = (
                     not prefix
+                    and not seq.generated
                     and self.engine._sp_prefill_eligible(n_tok)
                     and n_tok <= self.sp_admit_factor * self.prefill_chunk * sp_n
                 )
+                # resumed sequences always take the chunked path: their
+                # generated suffix must replay through the decode-shaped
+                # forward (see the replay phase in _admit_chunk) for
+                # byte-identical continuation
                 if (
-                    prefix or len(seq.prompt_ids) > self.prefill_chunk
+                    prefix or n_tok > self.prefill_chunk or seq.generated
                 ) and not sp_long:
                     if self.paged_native_prefill:
                         self._start_chunked_paged(seq, slot, prefix)
@@ -143,6 +175,23 @@ class AdmissionMixin:
                         self._start_chunked(seq, slot, prefix)
                     return  # one chunked admission at a time
                 self._admit(seq, slot)
+            except PoolPressure:
+                # pressure with no viable victim mid-admission: release the
+                # slot and put the request back at the FRONT of the queue
+                # (head-of-line order preserved) — it retries as slots
+                # free. NOT a failure: no accepted request is dropped.
+                self._admitting = None
+                self.engine._allocator.free(slot)
+                self._slots[slot] = None
+                seq.slot = -1
+                seq.prefilling = False
+                seq.prefix_match = None
+                seq.lazy = False
+                METRICS.incr("scheduler.admission_blocked")
+                self._update_sched_gauges()
+                with self._lock:
+                    self._waiting.appendleft(seq)
+                return
             except BaseException as exc:  # noqa: BLE001
                 self._abort_admission(seq, slot, exc)
 
@@ -183,22 +232,37 @@ class AdmissionMixin:
         seq.out.put(exc)
 
 
+    def _admission_tokens(self, seq: _Seq) -> int:
+        """How many token positions this admission reserves pages for: the
+        full worst case, or — lazy mode (set by _admit_ready under
+        pressure) — just the prefill plus one multi-step scan, grown on
+        demand by the decode growth pre-pass."""
+        full = min(len(seq.prompt_ids) + seq.budget, self.engine.max_seq_len)
+        if not seq.lazy:
+            return full
+        return min(len(self._prefill_ids(seq)) + self.multistep + 1, full)
+
+
     def _admit(self, seq: _Seq, slot: int) -> None:
         FAULTS.check("admission.prefill", seq=seq, rid=seq.rid)
         eng = self.engine
         cfg = eng.cfg
         alloc = eng._allocator
-        prompt = seq.prompt_ids
-        n = len(prompt)
-        need = alloc.pages_needed(min(n + seq.budget, eng.max_seq_len))
-        alloc.alloc(slot, need)
+        ids = self._prefill_ids(seq)
+        n = len(ids)
+        need = alloc.pages_needed(self._admission_tokens(seq))
+        if self._alloc_pages(seq, slot, need) is None:
+            raise PoolPressure(
+                f"no viable victim could free {need} pages for {seq.rid} "
+                "at admission"
+            )
 
         with METRICS.span("prefill", jax_trace=True):
             from fei_tpu.engine.engine import _next_bucket
 
             bucket = min(_next_bucket(n), eng.max_seq_len)
             dense = KVCache.create(cfg, 1, bucket, dtype=eng.dtype)
-            last_logits, dense = eng.prefill([prompt], dense)
+            last_logits, dense = eng.prefill([ids], dense)
             last_logits.block_until_ready()
 
         self._complete_admission(seq, slot, dense, bucket, last_logits)
@@ -217,7 +281,7 @@ class AdmissionMixin:
         prefix = prefix or []
         m = self._reserve_admission(seq, slot, prefix)
         ps = alloc.page_size
-        n = len(seq.prompt_ids)
+        n = len(self._prefill_ids(seq))
         from fei_tpu.engine.engine import _next_bucket
 
         # the bucket MUST fit every full chunk write: chunks write C-row
@@ -274,9 +338,12 @@ class AdmissionMixin:
         eng = self.engine
         alloc = eng._allocator
         m = len(prefix)
-        n = len(seq.prompt_ids)
-        need = alloc.pages_needed(min(n + seq.budget, eng.max_seq_len))
-        alloc.alloc(slot, need - m)
+        need = alloc.pages_needed(self._admission_tokens(seq))
+        if self._alloc_pages(seq, slot, need - m) is None:
+            raise PoolPressure(
+                f"no viable victim could free {need - m} pages for "
+                f"{seq.rid} at admission"
+            )
         seq.prefilling = True
         return m
 
@@ -316,12 +383,56 @@ class AdmissionMixin:
         FAULTS.check("admission.prefill", seq=seq, rid=seq.rid)
         eng = self.engine
         C = self.prefill_chunk
-        prompt = seq.prompt_ids
+        prompt = self._prefill_ids(seq)
         n, lo = len(prompt), st["pos"]
         hi = min(lo + C, n)
         toks = np.zeros((1, C), dtype=np.int32)
         toks[0, : hi - lo] = prompt[lo:hi]
         final = hi >= n
+        if st.get("mode") == "paged" and seq.generated:
+            # preempt-resume: the chunk kernel's batched matmuls round the
+            # generated positions ~1 bf16 ulp differently than the decode
+            # step that originally produced them — enough to flip a
+            # near-tied argmax downstream. Chunk-prefill ONLY the prompt
+            # (and any cached-prefix) positions, then REPLAY the generated
+            # suffix through the decode-shaped [B, 1] forward so the
+            # rebuilt KV is bitwise what the unpreempted stream held.
+            n_pre = min(n, max(
+                len(seq.prompt_ids), st.get("prefix", 0) * eng.page_size
+            ))
+            if lo >= n_pre:
+                if lo < n:  # replay one decode-shaped chunk of the suffix
+                    R = max(1, self.multistep)
+                    hi = min(lo + R, n)
+                    rt = np.zeros((R,), dtype=np.int32)
+                    rt[: hi - lo] = prompt[lo:hi]
+                    with METRICS.span("prefill_chunk", jax_trace=True):
+                        self._pool = self._replay_fn(R)(
+                            eng.params, self._pool, jnp.asarray(rt),
+                            jnp.asarray(st["row"]), jnp.int32(st["slot"]),
+                            jnp.asarray(lo, dtype=jnp.int32),
+                        )
+                    METRICS.incr(
+                        "scheduler.resume_replayed_tokens", hi - lo
+                    )
+                    st["pos"] = hi
+                    if hi < n:
+                        return  # more replay chunks; decode interleaves
+                self._admitting = None
+                self._complete_admission_paged(
+                    seq, st["slot"], None, st["row"],
+                    prefix_pages=st.get("prefix", 0),
+                )
+                return
+            # prompt phase of a resume: walk the SAME chunk programs the
+            # original admission compiled — including the logits epilogue
+            # on the last prompt chunk (its fusion shifts the chunk's KV
+            # rounding by an ulp; the logits themselves are discarded,
+            # resume never samples from prefill)
+            hi = min(lo + C, n_pre)
+            toks = np.zeros((1, C), dtype=np.int32)
+            toks[0, : hi - lo] = prompt[lo:hi]
+            final = hi >= n_pre
         if st.get("mode") == "paged":
             try:
                 with METRICS.span("prefill_chunk", jax_trace=True):
@@ -357,16 +468,20 @@ class AdmissionMixin:
                     seq.slot = -1
                     seq.prefilling = False
                     seq.prefix_match = None  # pins dropped: re-probe
+                    seq.lazy = False  # re-decided at the next admission
                     with self._lock:
                         self._waiting.appendleft(seq)
                     return
                 raise
             st["pos"] = hi
-            if not final:
-                return  # more chunks; decode steps interleave
+            if not final or hi < n:
+                # more prompt chunks — or, on a resume, the generated
+                # suffix still has to replay; decode steps interleave
+                return
             self._admitting = None
             self._complete_admission_paged(
-                seq, st["slot"], last_logits, st["row"]
+                seq, st["slot"], last_logits, st["row"],
+                prefix_pages=st.get("prefix", 0),
             )
             return
         with METRICS.span("prefill_chunk", jax_trace=True):
@@ -423,6 +538,49 @@ class AdmissionMixin:
         return self._pchunk_jit[key]
 
 
+    def _replay_fn(self, R: int):
+        """Compiled decode-path KV replay for preempt-resume: feed ``R``
+        already-sampled suffix tokens through the SAME [B, 1] forward the
+        decode scan uses, writing K/V into the resuming slot's pages.
+        Other slots' rows are zeroed in the replay view (their writes land
+        in the null page; the forward's math is row-local) and the live
+        table/lengths are restored on return, so interleaved decode never
+        sees the half-built slot. Pad tokens past the true suffix write
+        above the armed length into the slot's reserved pages (or, out of
+        range, the null page) and are never attended."""
+        if R not in self._replay_jit:
+            cfg = self.engine.cfg
+            mesh = self.engine.mesh
+            from fei_tpu.models.llama import forward_paged
+
+            def replay(params, pool, toks, row, slot, start):
+                bt0, ln0 = pool.block_table, pool.lengths
+                bt = jax.lax.dynamic_update_slice(
+                    jnp.zeros_like(bt0), row[None], (slot, 0)
+                )
+                ln = jax.lax.dynamic_update_slice(
+                    jnp.zeros_like(ln0), start[None], (slot,)
+                )
+                view = pool._replace(block_table=bt, lengths=ln)
+                B = bt0.shape[0]
+
+                def body(carry, tok):
+                    tokens = jax.lax.dynamic_update_slice(
+                        jnp.zeros((B, 1), dtype=jnp.int32),
+                        tok[None, None], (slot, 0),
+                    )
+                    _, carry = forward_paged(
+                        params, cfg, tokens, carry, kernel_mesh=mesh
+                    )
+                    return carry, None
+
+                view, _ = jax.lax.scan(body, view, toks)
+                return view._replace(block_table=bt0, lengths=ln0)
+
+            self._replay_jit[R] = jax.jit(replay, donate_argnums=(1,))
+        return self._replay_jit[R]
+
+
     def _arm_fn(self):
         """Compiled slot arming: install the block-table row and the true
         prompt length so decode starts reading the admitted pages."""
@@ -442,16 +600,26 @@ class AdmissionMixin:
 
 
     def _complete_admission_paged(
-        self, seq: _Seq, slot: int, last_logits, row: np.ndarray
+        self, seq: _Seq, slot: int, last_logits, row: np.ndarray,
+        prefix_pages: int = 0,
     ) -> None:
         """Admission tail for the paged-native path: sample the first
-        token, arm the slot's table row + length, register the prefix.
-        ``row`` is the block-table row the chunks wrote through (pages
-        cannot change mid-admission)."""
+        token (or re-install the resume key), arm the slot's table row +
+        length, register the prefix. ``row`` is the block-table row the
+        chunks wrote through (pages cannot change mid-admission)."""
         eng = self.engine
         alloc = eng._allocator
-        n = len(seq.prompt_ids)
-        tok0, rng = self._first_token(seq, last_logits)
+        ids = self._prefill_ids(seq)
+        n = len(ids)
+        resume = bool(seq.generated)
+        if resume:
+            # preempt-resume: the re-prefill over prompt + generated[:-1]
+            # rebuilt the pages; the saved per-slot PRNG key makes the
+            # continued sampling chain bit-identical. No first token — the
+            # last sampled token is already the next decode input.
+            tok0, rng = -1, jnp.asarray(seq.resume_key, dtype=jnp.uint32)
+        else:
+            tok0, rng = self._first_token(seq, last_logits)
         pages = alloc.pages_for(slot)
         self._pool = self._arm_fn()(
             self._pool, jnp.asarray(row), jnp.int32(slot),
@@ -459,16 +627,39 @@ class AdmissionMixin:
         )
         self._keys = self._keys.at[slot].set(rng)
         seq.prefilling = False
+        seq.row = np.array(row)
         if seq.trace is not None:
             seq.trace.event("prefill")
         if self._prefix is not None:
-            self._prefix.register(
-                seq.prompt_ids, pages[: alloc.pages_needed(n)]
-            )
+            self._prefix.register(ids, pages[: alloc.pages_needed(n)])
+        if resume:
+            self._resume_delivered(seq, n, prefix_pages)
+            return
         if seq.budget <= 0:
             self._finish(seq)
             return
         self._deliver(seq, tok0)
+
+
+    def _resume_delivered(self, seq: _Seq, n: int, prefix_pages: int) -> None:
+        """Resume tail shared by both admission paths: the stream
+        continues byte-identically — no token re-delivered, none dropped.
+        A warm-restart replay re-emits the recorded prefix to the fresh
+        consumer first (the old process's queue is gone)."""
+        alloc = self.engine._allocator
+        seq.next_input = seq.generated[-1]
+        if seq.trace is not None:
+            seq.trace.event("resumed")
+        METRICS.incr(
+            "scheduler.preempted_tokens_recomputed",
+            max(0, n - prefix_pages * alloc.page_size),
+        )
+        if seq.replay:
+            for t in seq.generated:
+                seq.out.put(t)
+            seq.replay = False
+        if len(seq.generated) >= seq.budget:
+            self._finish(seq)
 
 
     def _gather_fn(self, gm: int, bucket: int):
@@ -573,12 +764,18 @@ class AdmissionMixin:
         prefix_pages: int = 0,
     ) -> None:
         """Admission tail for the dense-staging path: sample the first
-        token, scatter the NEW prompt K/V into pages (cached-prefix pages
-        already hold theirs and are never rewritten), arm the slot."""
+        token (or re-install the resume key), scatter the NEW prefilled
+        K/V into pages (cached-prefix pages already hold theirs and are
+        never rewritten), arm the slot."""
         eng = self.engine
         alloc = eng._allocator
-        n = len(seq.prompt_ids)
-        tok0, rng = self._first_token(seq, last_logits)
+        ids = self._prefill_ids(seq)
+        n = len(ids)
+        resume = bool(seq.generated)
+        if resume:
+            tok0, rng = -1, jnp.asarray(seq.resume_key, dtype=jnp.uint32)
+        else:
+            tok0, rng = self._first_token(seq, last_logits)
 
         # suffix K/V → pages + block-table row + length, pool donated
         pages = alloc.pages_for(slot)  # prefix pages first, then fresh
@@ -595,11 +792,15 @@ class AdmissionMixin:
         )
         self._keys = self._keys.at[slot].set(rng)
         seq.prefilling = False
+        seq.row = np.array(row)
         if seq.trace is not None:
             seq.trace.event("prefill")
         if self._prefix is not None:
-            self._prefix.register(seq.prompt_ids, pages[:n_prompt_pages])
+            self._prefix.register(ids, pages[:n_prompt_pages])
 
+        if resume:
+            self._resume_delivered(seq, n, prefix_pages)
+            return
         if seq.budget <= 0:
             self._finish(seq)
             return

@@ -321,6 +321,49 @@ class DecodeMixin:
         )
 
 
+    def _grow_for_steps(self, active, n: int) -> None:
+        """Pre-dispatch growth pass for LAZY reservations: make sure every
+        active lazy slot has pages for the next ``n`` scanned positions,
+        allocating on demand under the pressure API (prefix-cache evict,
+        then preempt the least-progressed victim). A slot that cannot grow
+        even by preemption (no viable victim) preempts ITSELF and
+        re-admits later — the request is deferred, never failed. Fully-
+        reserved slots (``seq.lazy`` False) are untouched: their worst
+        case was allocated at admission and can never stall. A slot
+        preempted here (victim or self) stays in ``active`` but its
+        zeroed table row routes the scan's writes to the null page, and
+        the ``self._slots[b] is not s`` delivery guards drop its sampled
+        tokens."""
+        eng = self.engine
+        alloc = eng._allocator
+        for b, s in active:
+            if not s.lazy or self._slots[b] is not s or s.row is None:
+                continue
+            L = len(s.prompt_ids) + len(s.generated) - 1
+            target = min(len(s.prompt_ids) + s.budget, eng.max_seq_len)
+            want = min(L + n, target)
+            # capacity is ABSOLUTE: rolling-window (SWA) releases drop
+            # leading pages from pages_for while the device row keeps the
+            # stale entries — count them back in, and append new page ids
+            # at absolute row positions through the host mirror row
+            have = s.released_pages + len(alloc.pages_for(b))
+            grow = alloc.pages_needed(want) - have
+            if grow <= 0:
+                continue
+            got = self._alloc_pages(s, b, grow, locked=False)
+            if got is None:
+                self._preempt_seq(s, locked=False)
+                continue
+            row = s.row
+            for i, p in enumerate(got):
+                row[have + i] = p
+            self._pool = self._arm_fn()(
+                self._pool, jnp.asarray(row), jnp.int32(b),
+                jnp.asarray(L, dtype=jnp.int32),
+            )
+            METRICS.incr("scheduler.lazy_grown_pages", len(got))
+
+
     def _dispatch_steps(
         self, active, n: int, mask: np.ndarray | None = None
     ) -> np.ndarray:
@@ -332,6 +375,7 @@ class DecodeMixin:
         in ``self._step_keys`` ([n, B, 2], stays on device) so a
         free-phase trigger rollback can restore a slot's exact mid-scan
         key state."""
+        self._grow_for_steps(active, n)
         FAULTS.check("decode.dispatch")
         eng = self.engine
         B = self.B
@@ -372,7 +416,10 @@ class DecodeMixin:
         METRICS.gauge("scheduler.batch_slots_active", len(active))
         with METRICS.span("decode_step"):
             nxt, self._step_keys, self._pool, self._keys = step(*args, **kw)
-            return np.asarray(nxt)  # host sync inside the span
+            out = np.asarray(nxt)  # host sync inside the span
+        for _, s in active:
+            s.shield = False  # survived a dispatch: victimizable again
+        return out
 
 
     def _multi_fn(self, n_steps: int, grammared: bool, masked: bool = False):

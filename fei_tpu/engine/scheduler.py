@@ -55,7 +55,9 @@ from fei_tpu.utils.errors import (
     DeadlineExceededError,
     DeviceError,
     EngineDegradedError,
+    EngineDrainingError,
     EngineError,
+    PoolPressure,
     QueueFullError,
 )
 from fei_tpu.utils.logging import get_logger
@@ -109,6 +111,27 @@ class _Seq:
     # absolute perf_counter deadline (0 = none): expired-while-queued
     # requests shed at admission, decoding ones cancel at the reap sweep
     deadline: float = 0.0
+    # preempt-and-resume state. ``resume_key`` is the slot's PRNG key
+    # captured at preemption (host uint32[2]) and re-installed at
+    # re-admission, so the resumed stream's sampling chain is
+    # bit-identical to the unpreempted run. ``row`` mirrors the slot's
+    # device block-table row on the host in ABSOLUTE page indices —
+    # rolling-window releases drop leading pages from pages_for() while
+    # the device row keeps the stale entries, so mid-decode growth must
+    # append at absolute positions, never rebuild the row. ``lazy``
+    # marks a reservation covering only the prefill + one scan (grown
+    # on demand under the pressure API) instead of the full worst case.
+    # ``replay`` re-emits the recorded tokens to a fresh out queue at
+    # arm time (warm restart: the old process's consumer is gone).
+    # ``shield`` guards a freshly (re-)admitted sequence from being
+    # picked as a preemption victim until it survives one decode
+    # dispatch — without it, back-to-back admissions under pressure
+    # preempt each other before anyone decodes (admission livelock).
+    resume_key: np.ndarray | None = None
+    row: np.ndarray | None = None
+    lazy: bool = False
+    replay: bool = False
+    shield: bool = False
 
 
 class PagedScheduler(AdmissionMixin, DecodeMixin, ConstraintMixin):
@@ -215,7 +238,36 @@ class PagedScheduler(AdmissionMixin, DecodeMixin, ConstraintMixin):
         )
         self._fail_times: deque[float] = deque()
         self._degraded_until = 0.0
+        # memory pressure as a scheduling event: when a page allocation
+        # cannot be satisfied, the pressure API evicts prefix-cache
+        # references and then PREEMPTS the least-progressed victim
+        # (snapshot + release + requeue; it resumes byte-identically via
+        # re-admission) instead of raising. "off" restores the legacy
+        # behavior: full worst-case reservation at admission, blocking
+        # head-of-line when the pool is tight, no preemption.
+        self.preempt_policy = _os.environ.get(
+            "FEI_TPU_PREEMPT_POLICY", "min-progress"
+        )
+        if self.preempt_policy not in ("min-progress", "off"):
+            raise EngineError(
+                f"unknown FEI_TPU_PREEMPT_POLICY "
+                f"{self.preempt_policy!r} (min-progress | off)"
+            )
+        # graceful drain: SIGTERM / POST /drain flips _draining — new
+        # submits shed with EngineDrainingError, in-flight requests
+        # finish within drain_deadline_s, then still-queued (and
+        # deadline-stranded running) requests snapshot to drain_dir for
+        # warm restart
+        self.drain_deadline_s = float(
+            _os.environ.get("FEI_TPU_DRAIN_DEADLINE_S", "30")
+        )
+        self.drain_dir = _os.environ.get("FEI_TPU_DRAIN_DIR", "")
+        self._draining = False
+        self._drain_deadline = 0.0
+        self._drain_dir: str | None = None
+        self._drained = threading.Event()
         self._pchunk_jit: dict = {}
+        self._replay_jit: dict = {}  # decode-path resume replay, per R
         self._arm_jit = None
         self._closed = False
         self._admitting: dict | None = None  # in-flight chunked admission
@@ -266,6 +318,7 @@ class PagedScheduler(AdmissionMixin, DecodeMixin, ConstraintMixin):
     def submit(
         self, prompt_ids, gen, logit_mask_fn=None,
         grammar=None, grammar_trigger: str | None = None,
+        _restore: dict | None = None,
     ) -> _Seq:
         """``grammar`` (a TokenGrammar) runs DEVICE-NATIVE: the DFA mask is
         computed inside the compiled step from per-slot states — unlike
@@ -274,6 +327,15 @@ class PagedScheduler(AdmissionMixin, DecodeMixin, ConstraintMixin):
         freely until the trigger text appears, then constrains (the agent
         tool-call protocol); without it the whole output is constrained."""
         eng = self.engine
+        if self._draining:
+            METRICS.incr("scheduler.requests_shed")
+            raise EngineDrainingError(
+                "engine is draining; retry against another replica",
+                retry_after_s=max(
+                    self.retry_after_s,
+                    self._drain_deadline - time.monotonic(),
+                ),
+            )
         if self.degraded():
             METRICS.incr("scheduler.requests_shed")
             raise EngineDegradedError(
@@ -324,6 +386,21 @@ class PagedScheduler(AdmissionMixin, DecodeMixin, ConstraintMixin):
             seq.deadline = seq.t_queued + dl
         seq.trace = TRACES.start(prompt_tokens=n)
         seq.rid = seq.trace.rid
+        if _restore is not None:
+            # warm restart: rebuild the preempt-resume state BEFORE the seq
+            # is visible to the scheduler thread — re-admission then takes
+            # the resume path (re-prefill prompt + generated[:-1], saved
+            # PRNG key re-installed) and replays the already-delivered
+            # tokens to the fresh consumer, so the stream is byte-identical
+            # to the uninterrupted run.
+            seq.generated = [int(t) for t in _restore.get("generated", [])]
+            key = _restore.get("resume_key")
+            if key is not None:
+                seq.resume_key = np.asarray(key, dtype=np.uint32)
+            seq.replay = bool(seq.generated)
+            rem = _restore.get("deadline_remaining_s")
+            if rem is not None:
+                seq.deadline = seq.t_queued + float(rem)
         METRICS.incr("scheduler.requests_submitted")
         appended = False
         if grammar is not None:
@@ -460,6 +537,17 @@ class PagedScheduler(AdmissionMixin, DecodeMixin, ConstraintMixin):
                             return
                     continue
                 self._reap_cancelled()
+                if self._draining:
+                    if self._admitting is not None:
+                        # an ACCEPTED chunked admission finishes its
+                        # prefill; nothing new leaves the waiting queue
+                        # while draining (_admit_ready checks _draining)
+                        self._admit_ready()
+                    if self._drain_step():
+                        with self._lock:
+                            self._thread = None
+                            return
+                    continue
                 self._admit_ready()
                 if not any(self._slots):
                     if not self._waiting and self._admitting is None:
@@ -610,27 +698,33 @@ class PagedScheduler(AdmissionMixin, DecodeMixin, ConstraintMixin):
             seq.gaccepted = bool(seq.gfallback_state.get("accepted"))
         slot = seq.slot
         if slot >= 0 and self._slots[slot] is seq:
-            if self._evict_jit is None:
-                width = self._pool.block_table.shape[1]
-
-                def evict(pool, slot_idx):
-                    bt = jax.lax.dynamic_update_slice(
-                        pool.block_table,
-                        jnp.zeros((1, width), dtype=jnp.int32),
-                        (slot_idx, 0),
-                    )
-                    ln = jax.lax.dynamic_update_slice(
-                        pool.lengths, jnp.zeros((1,), dtype=jnp.int32), (slot_idx,)
-                    )
-                    return pool._replace(block_table=bt, lengths=ln)
-
-                self._evict_jit = jax.jit(evict, donate_argnums=(0,))
-            self._pool = self._evict_jit(self._pool, jnp.int32(slot))
-            self.engine._allocator.free(slot)
-            self._slots[slot] = None
+            self._evict_slot(slot)
         self._trace_finish(seq, "cancelled" if seq.cancelled else "completed")
         self._update_sched_gauges()
         seq.out.put(_DONE)
+
+    def _evict_slot(self, slot: int) -> None:
+        """Zero the slot's device block-table row + length (future KV
+        writes for the slot land in the reserved null page 0) and return
+        its pages to the pool. Shared by completion and preemption."""
+        if self._evict_jit is None:
+            width = self._pool.block_table.shape[1]
+
+            def evict(pool, slot_idx):
+                bt = jax.lax.dynamic_update_slice(
+                    pool.block_table,
+                    jnp.zeros((1, width), dtype=jnp.int32),
+                    (slot_idx, 0),
+                )
+                ln = jax.lax.dynamic_update_slice(
+                    pool.lengths, jnp.zeros((1,), dtype=jnp.int32), (slot_idx,)
+                )
+                return pool._replace(block_table=bt, lengths=ln)
+
+            self._evict_jit = jax.jit(evict, donate_argnums=(0,))
+        self._pool = self._evict_jit(self._pool, jnp.int32(slot))
+        self.engine._allocator.free(slot)
+        self._slots[slot] = None
 
     def _trace_finish(self, seq: _Seq, status: str) -> None:
         """Terminal trace event + lifecycle counter (idempotent — the
@@ -716,6 +810,302 @@ class PagedScheduler(AdmissionMixin, DecodeMixin, ConstraintMixin):
             s.finished = True
             self._trace_finish(s, "failed")
             s.out.put(exc)
+
+    # -- memory pressure: preemption + pressure-aware allocation -------------
+
+    def _prefill_ids(self, seq: _Seq) -> list[int]:
+        """The token ids a (re-)admission must prefill. Fresh requests
+        prefill the prompt; a preempted sequence re-prefills prompt +
+        generated[:-1] — its last sampled token stays the next decode
+        INPUT, exactly as it was pre-preemption, so the resumed chain
+        emits the same bytes with no duplicate or dropped token."""
+        if seq.generated:
+            return seq.prompt_ids + seq.generated[:-1]
+        return seq.prompt_ids
+
+    def _pick_victim(self, exclude: _Seq | None) -> _Seq | None:
+        """min-progress policy: the running sequence least far toward its
+        budget loses (it has the least recompute to throw away and the
+        prefix cache makes its re-prefill cheap); ties go to the lowest
+        slot. The requester is excluded — a requester that must
+        self-preempt does so explicitly in the decode growth path.
+        Shielded slots (admitted but not yet through one decode
+        dispatch) are also skipped: preempting those livelocks
+        admissions against each other with zero tokens of progress."""
+        best = None
+        best_p = None
+        for s in self._slots:
+            if s is None or s is exclude or s.finished or s.shield:
+                continue
+            p = len(s.generated) / max(s.budget, 1)
+            if best_p is None or p < best_p:
+                best, best_p = s, p
+        return best
+
+    def _preempt_seq(self, seq: _Seq, *, locked: bool,
+                     requeue: bool = True) -> None:
+        """Snapshot + release + requeue one running sequence. The snapshot
+        is host state only (token lists, the slot's PRNG key, deadline);
+        its pages free immediately and re-admission re-prefills — through
+        the prefix cache, so most of the recompute is a page-table match.
+        ``locked`` says whether the caller already holds self._lock
+        (threading.Lock is not reentrant)."""
+        slot = seq.slot
+        if slot >= 0 and self._slots[slot] is seq:
+            if not seq.prefilling:
+                # capture the per-slot PRNG key so the resumed sampling
+                # chain is bit-identical; a victim still (re-)prefilling
+                # keeps whatever resume_key it already carried
+                seq.resume_key = np.asarray(self._keys[slot])
+            self._evict_slot(slot)
+        st = self._admitting
+        if st is not None and st.get("seq") is seq:
+            self._admitting = None
+        seq.slot = -1
+        seq.prefilling = False
+        seq.prefix_match = None
+        seq.released_pages = 0
+        seq.row = None
+        if seq.trace is not None:
+            seq.trace.event("preempted")
+        METRICS.incr("scheduler.preemptions")
+        log.info(
+            "preempted %s (%d/%d tokens) under pool pressure",
+            seq.rid, len(seq.generated), seq.budget,
+        )
+        if requeue:
+            if locked:
+                self._waiting.append(seq)
+            else:
+                with self._lock:
+                    self._waiting.append(seq)
+
+    def _ensure_free(self, seq: _Seq, n: int, *, preempt: bool,
+                     locked: bool = True) -> bool:
+        """Make ``n`` pages free for ``seq``: first ask the prefix cache
+        to give up unpinned entries, then (when allowed) preempt victims
+        one at a time — least progress first, never the requester.
+        False when the demand cannot be met (caller blocks or requeues).
+
+        The ``pool.alloc`` fault point is checked once per attempt, so an
+        armed ``exhausted:N`` models pressure persisting N attempts
+        (forcing the preemption path even on a roomy pool) and
+        ``transient:1`` clears after the first eviction retry."""
+        alloc = self.engine._allocator
+        attempt = 0
+        while True:
+            pressure = False
+            try:
+                FAULTS.check("pool.alloc", seq=seq, rid=seq.rid, n=n)
+            except PoolPressure:
+                pressure = True
+            if not pressure and alloc.free_pages >= n:
+                return True
+            attempt += 1
+            if attempt == 1:
+                if self._prefix is not None:
+                    self._prefix.evict_for(n)
+                continue
+            if not preempt or self.preempt_policy == "off":
+                return False
+            victim = self._pick_victim(exclude=seq)
+            if victim is None:
+                return False
+            self._preempt_seq(victim, locked=locked)
+
+    def _alloc_pages(self, seq: _Seq, slot: int, n: int, *,
+                     preempt: bool = True,
+                     locked: bool = False) -> list[int] | None:
+        """Pressure-aware page allocation for the scheduler paths: evict /
+        preempt until ``n`` pages are free, then allocate. None when the
+        pressure could not be relieved (no viable victim)."""
+        if n <= 0:
+            return []
+        alloc = self.engine._allocator
+        while True:
+            if not self._ensure_free(seq, n, preempt=preempt, locked=locked):
+                return None
+            got = alloc.try_alloc(slot, n)
+            if got is not None:
+                return got
+
+    # -- graceful drain + warm restart ---------------------------------------
+
+    def begin_drain(self, deadline_s: float | None = None,
+                    snapshot_dir: str | None = None) -> None:
+        """Flip the engine into draining: new submits shed with
+        EngineDrainingError (HTTP 503 + Retry-After), in-flight requests
+        finish within the deadline, then the still-queued set — and any
+        running request the deadline stranded — snapshots (to
+        ``snapshot_dir`` when set) for warm restart. Idempotent; sticky
+        for the process lifetime."""
+        with self._lock:
+            if self._draining:
+                return
+            self._draining = True
+            self._drain_deadline = time.monotonic() + (
+                self.drain_deadline_s if deadline_s is None else deadline_s
+            )
+            self._drain_dir = snapshot_dir if snapshot_dir is not None else (
+                self.drain_dir or None
+            )
+            busy = bool(
+                any(s is not None for s in self._slots)
+                or self._waiting
+                or self._admitting is not None
+            )
+            thread = self._thread
+            thread_alive = thread is not None and thread.is_alive()
+            if busy and not thread_alive:
+                self._start_thread()
+        METRICS.gauge("engine.draining", 1)
+        log.info(
+            "drain started (deadline %.1fs, snapshot dir %s)",
+            self._drain_deadline - time.monotonic(), self._drain_dir or "-",
+        )
+        thread = self._thread
+        if thread is None or not thread.is_alive():
+            # the loop cannot run (never started, already exited, or a
+            # harness stubbed _start_thread): in-flight work cannot make
+            # progress anyway, so finalize inline instead of hanging
+            # wait_drained() forever
+            self._finalize_drain()
+        self._wake.set()
+
+    def wait_drained(self, timeout: float | None = None) -> bool:
+        """Block until the drain finalized (in-flight done, queued
+        snapshotted). True when it completed within ``timeout``."""
+        return self._drained.wait(timeout)
+
+    def draining(self) -> bool:
+        return self._draining
+
+    def _drain_step(self) -> bool:
+        """One drain-mode loop iteration: keep stepping the in-flight set
+        until it quiesces or the drain deadline passes, then finalize.
+        True once the drain has finalized (the loop parks)."""
+        busy = (
+            any(s is not None for s in self._slots)
+            or self._admitting is not None
+        )
+        if busy and time.monotonic() < self._drain_deadline:
+            if any(s is not None for s in self._slots):
+                self._step_active()
+            else:
+                # only a chunked admission is in flight; _admit_ready
+                # advances it one chunk per loop iteration
+                self._wake.wait(timeout=0.01)
+                self._wake.clear()
+            return False
+        self._finalize_drain()
+        return True
+
+    def _finalize_drain(self) -> None:
+        """Snapshot everything still alive and declare the drain done.
+        Running sequences stranded past the deadline preempt-style
+        snapshot (no requeue) — their generated prefix rides along, so
+        the warm restart resumes them byte-identically. Constrained
+        requests (grammar / host-mask closures) are not portable across
+        processes; they fail typed instead of silently dropping their
+        constraint."""
+        with self._lock:
+            waiting = list(self._waiting)
+            self._waiting.clear()
+        st = self._admitting
+        if st is not None and st.get("seq") is not None:
+            s = st["seq"]
+            if not s.finished and not any(s is w for w in waiting):
+                waiting.insert(0, s)  # mid-admission: still just queued work
+        self._admitting = None
+        running = [
+            s for s in self._slots
+            if s is not None and not s.finished
+            and not any(s is w for w in waiting)
+        ]
+        for s in running:
+            self._preempt_seq(s, locked=False, requeue=False)
+        snaps: list[dict] = []
+        for s in running + waiting:
+            snap = self._snapshot_seq(s)
+            s.finished = True
+            if snap is None:
+                s.out.put(EngineDrainingError(
+                    "engine drained; this request's constraint (grammar / "
+                    "host mask closure) cannot be snapshotted across "
+                    "processes — resubmit it after restart",
+                    retry_after_s=self.retry_after_s,
+                ))
+                self._trace_finish(s, "failed")
+            else:
+                snaps.append(snap)
+                s.out.put(EngineDrainingError(
+                    "engine drained before this request completed; it was "
+                    "snapshotted for warm restart",
+                    retry_after_s=self.retry_after_s,
+                ))
+                self._trace_finish(s, "snapshotted")
+            s.out.put(_DONE)
+        if snaps and self._drain_dir:
+            from fei_tpu.engine import checkpoint
+
+            try:
+                checkpoint.save_request_snapshots(self._drain_dir, snaps)
+            except Exception as exc:  # noqa: BLE001
+                log.error("drain snapshot persistence failed: %r", exc)
+        self._update_sched_gauges()
+        log.info(
+            "drain finalized: %d request(s) snapshotted (%d preempted "
+            "from slots)", len(snaps), len(running),
+        )
+        self._drained.set()
+
+    def _snapshot_seq(self, seq: _Seq) -> dict | None:
+        """Host-resumable snapshot of one request, or None when it holds
+        process-local constraint state (grammar automata, mask closures)
+        that cannot be serialized."""
+        if (
+            seq.grammar is not None
+            or seq.mask_fn is not None
+            or seq.gscanner is not None
+            or seq.gfallback_state is not None
+        ):
+            return None
+        from dataclasses import asdict
+
+        gen = asdict(seq.gen)
+        gen["stop_token_ids"] = list(gen.get("stop_token_ids") or ())
+        snap = {
+            "rid": seq.rid,
+            "prompt_ids": [int(t) for t in seq.prompt_ids],
+            "generated": [int(t) for t in seq.generated],
+            "resume_key": (
+                None if seq.resume_key is None
+                else [int(x) for x in np.asarray(seq.resume_key).tolist()]
+            ),
+            "gen": gen,
+        }
+        if seq.deadline:
+            snap["deadline_remaining_s"] = max(
+                0.0, seq.deadline - time.perf_counter()
+            )
+        return snap
+
+    def restore_snapshots(self, snaps: list[dict]) -> list[_Seq]:
+        """Warm restart: resubmit persisted drain snapshots. Each resumes
+        through the preempt-resume path (re-prefill via the prefix cache,
+        saved PRNG key re-installed) and REPLAYS its already-delivered
+        tokens to the fresh out queue, so the new consumer sees the full
+        byte-identical stream from token 0."""
+        from fei_tpu.engine.engine import GenerationConfig
+
+        seqs = []
+        for snap in snaps:
+            gen_d = dict(snap.get("gen") or {})
+            gen_d["stop_token_ids"] = tuple(gen_d.get("stop_token_ids") or ())
+            gen = GenerationConfig(**gen_d)
+            seqs.append(self.submit(snap["prompt_ids"], gen, _restore=snap))
+            METRICS.incr("scheduler.requests_restored")
+        return seqs
 
     # -- shared device state ------------------------------------------------
 

@@ -41,6 +41,23 @@ METRIC_REGISTRY: dict[str, tuple[str, str]] = {
     "scheduler.admission_blocked": ("counter",
                                     "Admissions deferred by page-pool "
                                     "pressure."),
+    "scheduler.preemptions": (
+        "counter", "Sequences preempted under KV-pool pressure (snapshot "
+                   "+ release + requeue; they resume byte-identically)."),
+    "scheduler.preempted_tokens_recomputed": (
+        "counter", "Token positions re-prefilled when preempted sequences "
+                   "resumed (prefix-cache hits excluded)."),
+    "scheduler.resume_replayed_tokens": (
+        "counter", "Generated-suffix tokens replayed through the decode-"
+                   "shaped forward at resume (bitwise KV rebuild)."),
+    "scheduler.lazy_grown_pages": (
+        "counter", "KV pages allocated mid-decode for lazily-reserved "
+                   "sequences."),
+    "scheduler.requests_snapshotted": (
+        "counter", "Requests snapshotted to disk at drain for warm "
+                   "restart."),
+    "scheduler.requests_restored": (
+        "counter", "Snapshotted requests re-admitted by a warm restart."),
     "scheduler.decode_steps": ("counter",
                                "Device decode steps dispatched."),
     "scheduler.decode_slot_steps": ("counter",
@@ -97,6 +114,8 @@ METRIC_REGISTRY: dict[str, tuple[str, str]] = {
                          "(connection errors and 429/5xx)."),
     "server.profile_captures": ("counter",
                                 "On-demand jax.profiler captures taken."),
+    "server.drains": ("counter", "Graceful drains initiated via POST "
+                                 "/drain."),
     # --- gauges ---------------------------------------------------------
     "last_ttft_s": ("gauge", "TTFT of the most recent generation (s)."),
     "last_decode_tok_s": ("gauge",
@@ -106,6 +125,9 @@ METRIC_REGISTRY: dict[str, tuple[str, str]] = {
     "engine.degraded": ("gauge",
                         "1 while the crash-loop breaker holds the engine "
                         "degraded (submits rejected), else 0."),
+    "engine.draining": ("gauge",
+                        "1 once a graceful drain began (sticky for the "
+                        "process lifetime), else 0."),
     "scheduler.running_slots": ("gauge", "Sequences actively decoding."),
     "scheduler.batch_slots_active": ("gauge",
                                      "Active slots in the last decode "

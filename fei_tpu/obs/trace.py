@@ -2,8 +2,13 @@
 
 Every submitted sequence gets a request id and an ordered list of phase
 events — queued → admitted → prefill → first_token → completed/cancelled/
-failed/deadline_exceeded — kept in a bounded ring buffer (``FEI_TPU_TRACE_RING``, default
-256) and served by ``GET /v1/traces`` on ui/server.py. Setting
+failed/deadline_exceeded/snapshotted — kept in a bounded ring buffer
+(``FEI_TPU_TRACE_RING``, default 256) and served by ``GET /v1/traces`` on
+ui/server.py. Preempt-and-resume scheduling adds non-terminal
+``preempted`` / ``resumed`` events mid-trace: a sequence evicted under
+KV-pool pressure re-admits and continues byte-identically; ``snapshotted``
+is the terminal state of a request persisted to disk by a graceful drain
+for warm restart. Setting
 ``FEI_TPU_TRACE_FILE`` additionally appends each finished trace as one
 JSONL line, the flight-recorder shape production schedulers use to debug
 tail latency after the fact.
@@ -23,7 +28,9 @@ import uuid
 from collections import deque
 from dataclasses import dataclass, field
 
-TERMINAL_PHASES = ("completed", "cancelled", "failed", "deadline_exceeded")
+TERMINAL_PHASES = (
+    "completed", "cancelled", "failed", "deadline_exceeded", "snapshotted",
+)
 
 
 @dataclass

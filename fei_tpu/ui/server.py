@@ -30,6 +30,7 @@ from fei_tpu.obs.trace import TRACES
 from fei_tpu.utils.errors import (
     DeadlineExceededError,
     EngineDegradedError,
+    EngineDrainingError,
     QueueFullError,
 )
 from fei_tpu.utils.logging import get_logger
@@ -197,6 +198,11 @@ class ServeAPI:
         route, query = parts.path, parse_qs(parts.query)
         METRICS.incr("server.requests")
         if route == "/health":
+            if self._draining():
+                # a draining replica must leave the load-balancer rotation
+                # while its in-flight set finishes
+                return 503, {"status": "draining",
+                             "model": self.model_name}, {"Retry-After": "5"}
             if self._degraded():
                 # surface the crash-loop breaker so load balancers eject
                 # the replica instead of feeding it doomed requests
@@ -224,6 +230,8 @@ class ServeAPI:
             return 200, {"object": "list", "data": TRACES.recent(limit)}
         if route == "/v1/chat/completions" and method == "POST":
             return self._chat(body)
+        if route == "/drain" and method == "POST":
+            return self._drain(body)
         if route == "/debug/profile" and method == "POST":
             return self._profile(body)
         return 404, {"error": {"message": f"no route {method} {route}",
@@ -294,6 +302,36 @@ class ServeAPI:
         sched = getattr(eng, "_scheduler", None)
         return sched is not None and sched.degraded()
 
+    def _draining(self) -> bool:
+        """True when the backing engine is draining (SIGTERM or POST
+        /drain); new requests 503 with Retry-After."""
+        eng = getattr(self.provider, "engine", None)
+        sched = getattr(eng, "_scheduler", None)
+        return sched is not None and sched.draining()
+
+    def _drain(self, body: dict) -> tuple:
+        """Operator-initiated graceful drain — the HTTP twin of SIGTERM:
+        stop admitting, finish in-flight requests within the deadline,
+        snapshot the rest for warm restart. Idempotent."""
+        try:
+            deadline = body.get("deadline_s")
+            deadline = None if deadline is None else max(0.0, float(deadline))
+        except (TypeError, ValueError):
+            return 400, {"error": {"message": "deadline_s must be a number",
+                                   "type": "invalid_request_error"}}
+        eng = getattr(self.provider, "engine", None)
+        if eng is None or getattr(eng, "_scheduler", None) is None:
+            return 200, {"status": "drained"}  # nothing in flight to drain
+        eng.begin_drain(deadline_s=deadline)
+        METRICS.incr("server.drains")
+        return 202, {
+            "status": "draining",
+            "deadline_s": (
+                deadline if deadline is not None
+                else eng._scheduler.drain_deadline_s
+            ),
+        }
+
     @staticmethod
     def _retry_after(exc) -> dict:
         return {"Retry-After": str(max(1, round(
@@ -315,7 +353,7 @@ class ServeAPI:
             return 429, {"error": {"message": str(exc),
                                    "type": "overloaded_error"}}, \
                 self._retry_after(exc)
-        except EngineDegradedError as exc:
+        except (EngineDegradedError, EngineDrainingError) as exc:
             return 503, {"error": {"message": str(exc),
                                    "type": "overloaded_error"}}, \
                 self._retry_after(exc)
@@ -383,7 +421,10 @@ class ServeAPI:
             # errors can't change the status line — but the frame keeps
             # the typed category so clients can still back off
             etype = "server_error"
-            if isinstance(exc, (QueueFullError, EngineDegradedError)):
+            if isinstance(
+                exc,
+                (QueueFullError, EngineDegradedError, EngineDrainingError),
+            ):
                 etype = "overloaded_error"
             elif isinstance(exc, DeadlineExceededError):
                 etype = "timeout_error"
@@ -532,11 +573,60 @@ def main(argv: list[str] | None = None) -> int:
     server.start()
     log.info("model %s ready on http://%s:%d/v1 (ctrl-c to stop)",
              provider.engine.cfg.name, args.host, server.port)
+
+    # warm restart: re-admit requests a previous process snapshotted at
+    # drain. They decode to completion server-side (the old connections
+    # are gone; clients were told 503 + Retry-After), which primes the
+    # prefix cache for their retries and proves none were lost.
+    drain_dir = os.environ.get("FEI_TPU_DRAIN_DIR", "")
+    eng = getattr(provider, "engine", None)
+    if drain_dir and eng is not None:
+        try:
+            restored = eng.warm_restart(drain_dir)
+        except Exception as exc:  # noqa: BLE001 — boot must survive a
+            # corrupt snapshot file; the operator sees the log
+            log.warning("warm restart failed: %r", exc)
+            restored = []
+        if restored:
+            log.info("warm restart: re-admitted %d request(s)", len(restored))
+
+            def _finish_restored(s):
+                try:
+                    for _ in eng.scheduler.drain(s):
+                        pass
+                except Exception as exc:  # noqa: BLE001
+                    log.warning("restored request failed: %r", exc)
+
+            for s in restored:
+                threading.Thread(
+                    target=_finish_restored, args=(s,), daemon=True
+                ).start()
+
+    stopping = threading.Event()
+    got_term = threading.Event()
+
+    def _sigterm(signum, frame):  # noqa: ARG001
+        got_term.set()
+        stopping.set()
+
+    import signal
+
     try:
-        while True:
-            time.sleep(3600)
+        signal.signal(signal.SIGTERM, _sigterm)
+    except ValueError:
+        pass  # not the main thread (embedded use): no SIGTERM hook
+    try:
+        while not stopping.wait(3600):
+            pass
     except KeyboardInterrupt:
-        server.stop()
+        pass
+    if got_term.is_set() and eng is not None:
+        sched = getattr(eng, "_scheduler", None)
+        if sched is not None:
+            log.info("SIGTERM: draining before shutdown")
+            eng.begin_drain()
+            eng.wait_drained(sched.drain_deadline_s + 5.0)
+    server.stop()
     return 0
 
 

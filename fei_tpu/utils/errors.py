@@ -80,6 +80,26 @@ class DeadlineExceededError(RequestError):
     alone blew the budget) or cancelled mid-decode at delivery."""
 
 
+class PoolPressure(EngineError):
+    """The paged KV pool could not satisfy a page allocation on the
+    scheduler path. NOT a request failure: the scheduler treats pressure
+    as a scheduling event (evict prefix-cache entries, preempt a victim
+    sequence, retry) — only a request whose worst case can never fit the
+    pool fails, and that is rejected typed at submit()."""
+
+
+class EngineDrainingError(EngineError):
+    """The engine is draining (SIGTERM / POST /drain): new submits are
+    rejected (HTTP 503 + ``Retry-After``) while in-flight requests finish
+    within ``FEI_TPU_DRAIN_DEADLINE_S``; still-queued requests snapshot
+    to disk for warm restart."""
+
+    def __init__(self, message: str, *, retry_after_s: float = 5.0,
+                 cause: Exception | None = None):
+        super().__init__(message, cause=cause)
+        self.retry_after_s = retry_after_s
+
+
 class CheckpointError(EngineError):
     """Weight loading / checkpoint save-restore failure."""
 

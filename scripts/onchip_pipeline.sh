@@ -108,6 +108,21 @@ stage chaos_crashloop env FEI_TPU_TEST_PLATFORM=tpu \
   FEI_TPU_FAULT="decode.dispatch:device:3" FEI_TPU_BREAKER_FAILS=2 \
   FEI_TPU_BREAKER_WINDOW_S=60 python -m pytest \
   tests/test_faults.py::test_env_fault_sweep_recovers -q --timeout 300
+stage chaos_pool_exhausted env FEI_TPU_TEST_PLATFORM=tpu \
+  FEI_TPU_FAULT="pool.alloc:exhausted:4" python -m pytest \
+  tests/test_faults.py::test_env_fault_sweep_recovers -q --timeout 300
+stage chaos_pool_transient env FEI_TPU_TEST_PLATFORM=tpu \
+  FEI_TPU_FAULT="pool.alloc:transient:1" python -m pytest \
+  tests/test_faults.py::test_env_fault_sweep_recovers -q --timeout 300
+
+# 0d. KV-pressure preemption + graceful drain against real device
+# dispatches: byte-identical preempt-and-resume on a tight pool, and the
+# drain -> snapshot -> warm-restart replay (docs/ENGINE.md "Memory
+# pressure & preemption")
+stage preemption env FEI_TPU_TEST_PLATFORM=tpu python -m pytest \
+  tests/test_preemption.py -q --timeout 600
+stage drain_restart env FEI_TPU_TEST_PLATFORM=tpu python -m pytest \
+  tests/test_preemption.py::TestDrainRestart -q --timeout 600
 
 # ---- TIER 1: the gate + everything never measured on-chip (r3 stages 6b-9
 # plus the r4 additions). Run these while the window is young. ----

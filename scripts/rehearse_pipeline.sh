@@ -139,6 +139,23 @@ stage chaos_crashloop -- env FEI_TPU_FAULT="decode.dispatch:device:3" \
   FEI_TPU_BREAKER_FAILS=2 FEI_TPU_BREAKER_WINDOW_S=60 \
   python -m pytest tests/test_faults.py::test_env_fault_sweep_recovers -q \
   --timeout 300
+# exhausted:4 drives the hybrid reservation all the way to a preemption
+# (full reservation fails twice, lazy evicts once then preempts);
+# transient:1 stops at the evict-and-retry rung — no request may fail
+stage chaos_pool_exhausted -- env FEI_TPU_FAULT="pool.alloc:exhausted:4" \
+  python -m pytest tests/test_faults.py::test_env_fault_sweep_recovers -q \
+  --timeout 300
+stage chaos_pool_transient -- env FEI_TPU_FAULT="pool.alloc:transient:1" \
+  python -m pytest tests/test_faults.py::test_env_fault_sweep_recovers -q \
+  --timeout 300
+
+# --- KV-pressure preemption + graceful drain: byte-identical resume
+# under a deliberately tight pool, and the drain -> snapshot -> warm
+# restart replay proof (docs/ENGINE.md "Memory pressure & preemption").
+# These run FOR REAL here, same as the fault suite. ----
+stage preemption -- python -m pytest tests/test_preemption.py -q --timeout 600
+stage drain_restart -- python -m pytest \
+  tests/test_preemption.py::TestDrainRestart -q --timeout 600
 
 echo
 echo "=== rehearsal results ==="

@@ -1,0 +1,499 @@
+"""KV-page exhaustion survival: preempt-and-resume, drain, warm restart.
+
+The claims under test (docs/ENGINE.md "Memory pressure & preemption"):
+- page exhaustion is a scheduling event, never a request failure: when
+  the pool cannot cover an admission or mid-decode growth, the scheduler
+  preempts the least-progressed victim (never the requester, never a
+  freshly-admitted shielded slot), releases its pages, and requeues it;
+- a resumed stream is BYTE-IDENTICAL to an unpreempted run — greedy and
+  seeded — with no duplicated or dropped tokens (re-prefill of
+  prompt + generated[:-1], the slot's PRNG key captured at preemption
+  and re-installed at re-admission);
+- lazily-admitted sequences grow their reservation mid-decode through
+  the same pressure-aware path, self-preempting (deferred, not failed)
+  when no victim exists;
+- the allocator's refcounts survive the churn: registry-pinned prefix
+  pages outlive a victim's release, and a failed try_alloc has no
+  partial effects;
+- graceful drain sheds new submits with a typed Retry-After error,
+  snapshots whatever the deadline strands, and a warm restart re-admits
+  every snapshot and replays byte-identically — zero accepted requests
+  lost.
+
+A 4-token page over a ~13-page pool makes two worst-case reservations
+collide, so preemption triggers organically — no sleeps, no fault
+arming needed (the pool.alloc fault point is exercised separately).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+
+import pytest
+
+from fei_tpu.engine.checkpoint import (
+    CheckpointError,
+    clear_request_snapshots,
+    load_request_snapshots,
+    save_request_snapshots,
+)
+from fei_tpu.engine.engine import GenerationConfig, InferenceEngine
+from fei_tpu.engine.faults import FAULTS
+from fei_tpu.engine.paged_cache import PageAllocator
+from fei_tpu.utils.errors import EngineDrainingError, EngineError
+from fei_tpu.utils.metrics import METRICS
+
+PROMPTS = [list(range(11 + i, 29 + i)) for i in range(4)]
+PROMPT = PROMPTS[0]
+
+
+def _counter(name: str) -> float:
+    return METRICS.snapshot()["counters"].get(name, 0)
+
+
+def _gauge(name: str) -> float:
+    return METRICS.snapshot()["gauges"].get(name, 0)
+
+
+def _gen(**kw) -> GenerationConfig:
+    kw.setdefault("max_new_tokens", 24)
+    kw.setdefault("temperature", 0.0)
+    kw.setdefault("ignore_eos", True)
+    return GenerationConfig(**kw)
+
+
+def _make(**kwargs) -> InferenceEngine:
+    return InferenceEngine.from_config(
+        "tiny", paged=True, batch_size=kwargs.pop("batch_size", 2), **kwargs
+    )
+
+
+def _tight(**kwargs) -> InferenceEngine:
+    """A pool two worst-case reservations cannot share: page_size=4 puts
+    one 18-token-prompt 24-token-budget request at ceil(42/4) = 11 pages;
+    num_pages=14 leaves 13 allocatable (page 0 is the null page)."""
+    kwargs.setdefault("page_size", 4)
+    kwargs.setdefault("num_pages", 14)
+    kwargs.setdefault("prefix_cache", True)
+    return _make(**kwargs)
+
+
+def _run_concurrent(sched, prompts, gen):
+    """Drain one stream per prompt concurrently; returns (tokens, seq)
+    per prompt so tests can inspect the request traces afterwards."""
+    gens = gen if isinstance(gen, list) else [gen] * len(prompts)
+    seqs = [sched.submit(p, g) for p, g in zip(prompts, gens)]
+    results: list = [None] * len(prompts)
+
+    def go(i):
+        results[i] = list(sched.drain(seqs[i]))
+
+    ts = [threading.Thread(target=go, args=(i,)) for i in range(len(prompts))]
+    [t.start() for t in ts]
+    [t.join(timeout=300) for t in ts]
+    assert all(r is not None for r in results), "a stream never finished"
+    return list(zip(results, seqs))
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    FAULTS.disarm()
+    yield
+    FAULTS.disarm()
+
+
+class TestVictimPolicy:
+    """_pick_victim: min progress toward budget, requester and shielded
+    slots excluded."""
+
+    def _sched_with_slots(self, seqs):
+        eng = _make()
+        sched = eng.scheduler
+        for i, s in enumerate(seqs):
+            sched._slots[i] = s
+        return sched
+
+    def test_least_progress_loses(self):
+        from fei_tpu.engine.scheduler import _Seq
+
+        a = _Seq(prompt_ids=PROMPT, gen=_gen(), mask_fn=None, stops=set(), budget=24)
+        a.generated = [1] * 12  # 50%
+        b = _Seq(prompt_ids=PROMPT, gen=_gen(), mask_fn=None, stops=set(), budget=100)
+        b.generated = [1] * 10  # 10% — least progress despite more tokens
+        sched = self._sched_with_slots([a, b])
+        assert sched._pick_victim(exclude=None) is b
+        assert sched._pick_victim(exclude=b) is a
+
+    def test_shielded_and_finished_never_picked(self):
+        from fei_tpu.engine.scheduler import _Seq
+
+        a = _Seq(prompt_ids=PROMPT, gen=_gen(), mask_fn=None, stops=set(), budget=24)
+        a.shield = True  # admitted, no dispatch survived yet
+        b = _Seq(prompt_ids=PROMPT, gen=_gen(), mask_fn=None, stops=set(), budget=24)
+        b.finished = True
+        sched = self._sched_with_slots([a, b])
+        assert sched._pick_victim(exclude=None) is None
+
+    def test_policy_env_validated(self, monkeypatch):
+        monkeypatch.setenv("FEI_TPU_PREEMPT_POLICY", "meteor")
+        with pytest.raises(EngineError):
+            _make()
+
+
+class TestAllocatorUnderPreemption:
+    """Refcount invariants the preemption churn leans on."""
+
+    def test_try_alloc_exhaustion_has_no_partial_effects(self):
+        alloc = PageAllocator(num_pages=4, page_size=4)  # 3 allocatable
+        assert alloc.try_alloc(0, 2) is not None
+        free0 = alloc.free_pages
+        assert alloc.try_alloc(1, 2) is None
+        assert alloc.free_pages == free0
+        assert alloc.pages_for(1) == []
+        # fragmentation in contiguous mode is also a clean None
+        assert alloc.try_alloc(1, 1) is not None
+        assert alloc.free_pages == 0
+
+    def test_pinned_prefix_pages_survive_victim_release(self):
+        alloc = PageAllocator(num_pages=8, page_size=4)
+        pages = alloc.alloc(0, 4)
+        alloc.take_ref(pages[:2])  # the prefix registry's pin
+        alloc.free(0)  # the victim's preemption releases its refs
+        for p in pages[:2]:
+            assert alloc.refcount(p) == 1  # registry ref survives
+        for p in pages[2:]:
+            assert alloc.refcount(p) == 0
+        assert alloc.free_pages == 5
+        # a new sequence can share the pinned pages (resume's prefix hit)
+        alloc.share(1, pages[:2])
+        assert [alloc.refcount(p) for p in pages[:2]] == [2, 2]
+        alloc.drop_ref(pages[:2])  # registry eviction
+        alloc.free(1)
+        assert alloc.free_pages == 7  # everything returned exactly once
+
+    def test_exhaustion_raises_on_the_legacy_path(self):
+        alloc = PageAllocator(num_pages=4, page_size=4)
+        with pytest.raises(EngineError, match="exhausted"):
+            alloc.alloc(0, 99)
+
+    def test_pool_gauges_track_alloc_free(self):
+        alloc = PageAllocator(num_pages=8, page_size=4)
+        assert _gauge("pool.pages_total") == 7
+        assert _gauge("pool.pages_free") == 7
+        alloc.alloc(0, 3)
+        assert _gauge("pool.pages_in_use") == 3
+        alloc.free(0)
+        assert _gauge("pool.pages_free") == 7
+
+
+class TestPreemptResume:
+    def test_tight_pool_greedy_byte_identical(self):
+        # reference on the SAME page geometry (page_size=4) with a page
+        # count no reservation can exhaust: page size changes the attention
+        # summation order, so a roomy-default reference is only argmax-
+        # equal, not bit-equal — the claim here is that PRESSURE (preempt/
+        # resume) changes nothing, so only the page count may differ
+        gen = _gen()
+        roomy = _tight(num_pages=64)
+        # a chunk smaller than the prompt sends every admission — fresh
+        # AND resumed — through the same chunked-paged prefill programs;
+        # the default direct dense prefill is a different fused program
+        # that rounds ~1 bf16 ulp apart, which matters only when a
+        # preempted prompt must be recomputed after prefix-cache eviction
+        roomy.scheduler.prefill_chunk = 8
+        refs = [list(roomy.scheduler.stream(p, gen)) for p in PROMPTS]
+        roomy.scheduler.close()
+
+        p0 = _counter("scheduler.preemptions")
+        eng = _tight()
+        eng.scheduler.prefill_chunk = 8
+        results = _run_concurrent(eng.scheduler, PROMPTS, gen)
+        for i, (toks, _) in enumerate(results):
+            assert toks == refs[i], f"stream {i} diverged after preemption"
+        assert _counter("scheduler.preemptions") > p0
+        assert _counter("scheduler.preempted_tokens_recomputed") > 0
+        # a preempted request's trace shows the round trip, in order
+        phases = [
+            [p for p, _ in seq.trace.events] for _, seq in results
+        ]
+        preempted = [ph for ph in phases if "preempted" in ph]
+        assert preempted, "no trace recorded a preemption"
+        for ph in preempted:
+            assert "resumed" in ph
+            assert ph.index("resumed") > ph.index("preempted")
+            assert ph[-1] == "completed"
+
+    def test_tight_pool_seeded_byte_identical(self):
+        """The PRNG-key capture/restore proof: seeded sampling resumes on
+        the exact key the next step would have split. The reference runs
+        on the same page geometry (see the greedy test) — seeded top-k is
+        where a page-size-induced float reorder actually flips tokens."""
+        gens = [
+            _gen(temperature=1.0, top_k=40, seed=100 + i) for i in range(2)
+        ]
+        roomy = _tight(num_pages=64)
+        roomy.scheduler.prefill_chunk = 8  # same programs as the resume
+        refs = [
+            list(roomy.scheduler.stream(p, g))
+            for p, g in zip(PROMPTS[:2], gens)
+        ]
+        roomy.scheduler.close()
+
+        p0 = _counter("scheduler.preemptions")
+        eng = _tight()
+        eng.scheduler.prefill_chunk = 8
+        results = _run_concurrent(eng.scheduler, PROMPTS[:2], gens)
+        for i, (toks, _) in enumerate(results):
+            assert toks == refs[i], f"seeded stream {i} diverged"
+        assert _counter("scheduler.preemptions") > p0
+
+    @pytest.mark.slow  # pipeline `preemption` stage; tier-1 keeps the
+    # byte-identity + warm-restart pins within the fast-lane budget
+    def test_lazy_reservation_grows_mid_decode(self):
+        """A short request + a long one on a pool that fits the short
+        one's worst case plus only the long one's LAZY reservation: the
+        long request admits lazily and grows into the pages the short
+        one frees — no preemption needed, nothing fails."""
+        p0 = _counter("scheduler.preemptions")
+        g0 = _counter("scheduler.lazy_grown_pages")
+        roomy = _make()
+        ref_short = list(roomy.scheduler.stream(PROMPTS[0], _gen(max_new_tokens=4)))
+        ref_long = list(roomy.scheduler.stream(PROMPTS[1], _gen()))
+        roomy.scheduler.close()
+
+        eng = _tight(prefix_cache=False)  # exact page accounting
+        sched = eng.scheduler
+        # short first: full worst case ceil(22/4)=6 of 13; the long one's
+        # full 11 > 7 remaining, its lazy ceil(27/4)=7 <= 7 — admits lazy
+        results = _run_concurrent(
+            sched, PROMPTS[:2],
+            [_gen(max_new_tokens=4), _gen()],
+        )
+        assert results[0][0] == ref_short
+        assert results[1][0] == ref_long
+        assert _counter("scheduler.lazy_grown_pages") > g0
+        assert _counter("scheduler.preemptions") == p0
+
+    @pytest.mark.slow
+    def test_fault_forced_preemption_on_roomy_pool(self):
+        """pool.alloc exhausted:4 walks the hybrid ladder end-to-end on a
+        pool with plenty of pages: full reservation fails, lazy evicts
+        then preempts, and still no request fails."""
+        gen = _gen(max_new_tokens=8)
+        roomy = _make(prefix_cache=True)
+        refs = [list(roomy.scheduler.stream(p, gen)) for p in PROMPTS[:2]]
+        roomy.scheduler.close()
+
+        eng = _make(prefix_cache=True)
+        sched = eng.scheduler
+        held = sched.submit(PROMPTS[0], gen)  # a running victim candidate
+        FAULTS.arm(
+            "pool.alloc", "exhausted", count=4,
+            match=lambda ctx: ctx["seq"].prompt_ids == PROMPTS[1],
+        )
+        toks1 = list(sched.stream(PROMPTS[1], gen))
+        assert FAULTS.fired("pool.alloc") == 4
+        assert toks1 == refs[1]
+        # the other request (preempted or not) finished byte-identically
+        assert list(sched.drain(held)) == refs[0]
+
+    @pytest.mark.slow
+    def test_policy_off_blocks_instead_of_preempting(self, monkeypatch):
+        monkeypatch.setenv("FEI_TPU_PREEMPT_POLICY", "off")
+        gen = _gen()
+        # same page geometry as the pressured pool (see the greedy test)
+        roomy = _tight(num_pages=64)
+        refs = [list(roomy.scheduler.stream(p, gen)) for p in PROMPTS]
+        roomy.scheduler.close()
+
+        p0 = _counter("scheduler.preemptions")
+        eng = _tight()
+        results = _run_concurrent(eng.scheduler, PROMPTS, gen)
+        for i, (toks, _) in enumerate(results):
+            assert toks == refs[i]
+        # legacy behavior: admissions waited for pages, nobody was evicted
+        assert _counter("scheduler.preemptions") == p0
+
+    @pytest.mark.slow
+    def test_single_request_never_preempts(self):
+        p0 = _counter("scheduler.preemptions")
+        eng = _tight()
+        toks = list(eng.scheduler.stream(PROMPT, _gen()))
+        assert len(toks) == 24
+        assert _counter("scheduler.preemptions") == p0
+
+    def test_infeasible_request_still_rejected_up_front(self):
+        eng = _tight()
+        with pytest.raises(EngineError):
+            eng.scheduler.submit(PROMPT, _gen(max_new_tokens=4096))
+
+
+class TestDrainRestart:
+    def test_drain_sheds_new_submits_with_retry_after(self):
+        eng = _make()
+        eng.begin_drain(deadline_s=5)
+        assert eng.scheduler.wait_drained(timeout=10)
+        assert _gauge("engine.draining") == 1
+        with pytest.raises(EngineDrainingError) as e:
+            eng.scheduler.submit(PROMPT, _gen())
+        assert e.value.retry_after_s > 0
+
+    def test_queued_requests_snapshot_and_warm_restart_replays(
+        self, monkeypatch, tmp_path
+    ):
+        """The zero-loss proof, fully deterministic: requests parked in
+        the queue drain to disk, a FRESH engine re-admits them, and each
+        replays byte-identically to an undrained run."""
+        gen = _gen()
+        roomy = _make(prefix_cache=True)
+        refs = [list(roomy.scheduler.stream(p, gen)) for p in PROMPTS[:2]]
+        roomy.scheduler.close()
+
+        eng = _make()
+        sched = eng.scheduler
+        monkeypatch.setattr(sched, "_start_thread", lambda: None)  # park
+        seqs = [sched.submit(p, gen) for p in PROMPTS[:2]]
+        s0 = _counter("scheduler.requests_snapshotted")
+        eng.begin_drain(deadline_s=0, snapshot_dir=str(tmp_path))
+        assert sched.wait_drained(timeout=10)
+        assert _counter("scheduler.requests_snapshotted") == s0 + 2
+        for s in seqs:
+            assert s.trace.status == "snapshotted"
+            # the old process's waiter gets a typed, Retry-After error
+            with pytest.raises(EngineDrainingError):
+                list(sched.drain(s))
+
+        snaps = load_request_snapshots(str(tmp_path))
+        assert len(snaps) == 2
+        eng2 = _make(prefix_cache=True)
+        restored = eng2.warm_restart(str(tmp_path))
+        assert len(restored) == 2
+        # at-most-once: the snapshot file is consumed
+        assert load_request_snapshots(str(tmp_path)) == []
+        assert eng2.warm_restart(str(tmp_path)) == []
+        outs = [list(eng2.scheduler.drain(s)) for s in restored]
+        assert outs == refs
+
+    @pytest.mark.slow
+    def test_mid_decode_drain_loses_nothing(self, tmp_path):
+        """Drain while a request is actively decoding: whatever the
+        deadline strands snapshots, and delivered-before + replayed-after
+        reconstructs the exact reference stream."""
+        gen = _gen(max_new_tokens=64)
+        roomy = _make()
+        # chunked-paged prefill everywhere (see the greedy byte-identity
+        # test): the fresh engine's restart re-prefills the prompt through
+        # the chunked programs, so the reference and the drained run must
+        # compile the same ones
+        roomy.scheduler.prefill_chunk = 8
+        ref = list(roomy.scheduler.stream(PROMPT, gen))
+        roomy.scheduler.close()
+
+        eng = _make()
+        eng.scheduler.prefill_chunk = 8
+        sched = eng.scheduler
+        seq = sched.submit(PROMPT, gen)
+        it = sched.drain(seq)
+        before = [next(it) for _ in range(4)]  # decoding is underway
+        eng.begin_drain(deadline_s=0, snapshot_dir=str(tmp_path))
+        assert sched.wait_drained(timeout=30)
+        snapshotted = False
+        try:  # collect whatever was delivered up to the snapshot point
+            for t in it:
+                before.append(t)
+        except EngineDrainingError:
+            snapshotted = True
+
+        if snapshotted:
+            assert seq.trace.status == "snapshotted"
+            eng2 = _make()
+            eng2.scheduler.prefill_chunk = 8
+            restored = eng2.warm_restart(str(tmp_path))
+            assert len(restored) == 1
+            after = list(eng2.scheduler.drain(restored[0]))
+            # the replay re-emits everything delivered pre-drain, then
+            # continues: the restored stream IS the full reference
+            assert after == ref
+            assert after[: len(before)] == before
+            assert _counter("scheduler.requests_restored") >= 1
+        else:  # the deadline let it finish: complete, not snapshotted
+            assert before == ref
+            assert load_request_snapshots(str(tmp_path)) == []
+
+    def test_drain_is_idempotent_and_sticky(self):
+        eng = _make()
+        eng.begin_drain(deadline_s=1)
+        eng.begin_drain(deadline_s=99)  # no-op: first drain wins
+        assert eng.scheduler.wait_drained(timeout=10)
+        assert eng.scheduler.draining()
+
+    def test_constrained_request_fails_typed_at_drain(self, monkeypatch):
+        """Grammar automaton state is not host-portable: a constrained
+        request cannot snapshot, so drain fails it with the typed
+        draining error instead of silently dropping it."""
+        eng = _make()
+        sched = eng.scheduler
+        monkeypatch.setattr(sched, "_start_thread", lambda: None)
+        seq = sched.submit(PROMPT, _gen())
+        seq.mask_fn = lambda toks: None  # host-masked == constrained
+        eng.begin_drain(deadline_s=0)
+        assert sched.wait_drained(timeout=10)
+        with pytest.raises(EngineDrainingError):
+            list(sched.drain(seq))
+        assert seq.trace.status == "failed"
+
+
+class TestCheckpointRoundtrip:
+    def test_save_load_clear(self, tmp_path):
+        snaps = [{"rid": "req-1", "prompt_ids": [1, 2], "generated": [3]}]
+        save_request_snapshots(str(tmp_path), snaps)
+        assert load_request_snapshots(str(tmp_path)) == snaps
+        clear_request_snapshots(str(tmp_path))
+        assert load_request_snapshots(str(tmp_path)) == []
+        clear_request_snapshots(str(tmp_path))  # idempotent
+
+    def test_corrupt_file_is_a_typed_error(self, tmp_path):
+        (tmp_path / "requests.json").write_text("not json{")
+        with pytest.raises(CheckpointError):
+            load_request_snapshots(str(tmp_path))
+
+    def test_wrong_version_rejected(self, tmp_path):
+        (tmp_path / "requests.json").write_text(
+            json.dumps({"version": 999, "requests": []})
+        )
+        with pytest.raises(CheckpointError):
+            load_request_snapshots(str(tmp_path))
+
+
+class TestServerDrain:
+    def test_drain_endpoint_and_health_flip(self):
+        from fei_tpu.agent.providers import JaxLocalProvider
+        from fei_tpu.ui.server import ServeAPI
+
+        eng = _make()
+        api = ServeAPI(JaxLocalProvider(engine=eng), model_name="tiny")
+        assert api.handle("GET", "/health", {}, {})[0] == 200
+
+        res = api.handle("POST", "/drain", {"deadline_s": 2}, {})
+        assert res[0] == 202 and res[1]["status"] == "draining"
+        assert eng.scheduler.wait_drained(timeout=10)
+
+        # /health flips so load balancers eject the replica...
+        code, body, hdrs = api.handle("GET", "/health", {}, {})
+        assert code == 503 and body["status"] == "draining"
+        assert int(hdrs["Retry-After"]) >= 1
+        # ...and new chat submits shed 503 + Retry-After
+        chat = {"messages": [{"role": "user", "content": "hi"}],
+                "max_tokens": 4}
+        res = api.handle("POST", "/v1/chat/completions", chat, {})
+        assert res[0] == 503 and int(res[2]["Retry-After"]) >= 1
+
+    def test_drain_endpoint_validates_deadline(self):
+        from fei_tpu.agent.providers import JaxLocalProvider
+        from fei_tpu.ui.server import ServeAPI
+
+        eng = _make()
+        api = ServeAPI(JaxLocalProvider(engine=eng), model_name="tiny")
+        assert api.handle("POST", "/drain", {"deadline_s": "soon"}, {})[0] == 400
