@@ -32,6 +32,11 @@ Suites (FEI_TPU_BENCH_SUITE):
   federation       — BASELINE config #5 shape: 4-node shared-embedding
                      all-gather bandwidth + propose->consensus p50 on the
                      hermetic 4-device CPU mesh
+  sharded          — the mesh-mode ladder: the paged workload at ms1, tp2,
+                     tp2dp2 (FEI_TPU_BENCH_MESH_LADDER) with per-rung
+                     aggregate tok/s, slot counts (dp multiplies them) and
+                     a greedy token-parity probe vs the ms1 rung; on a CPU
+                     backend it re-execs onto the 8-device host mesh
 
 Knobs:
   FEI_TPU_BENCH_MODEL    (decode default llama3-8b — the BASELINE config #2
@@ -178,6 +183,14 @@ def _emit(metric: str, value: float, unit: str = "tok/s/chip",
         "unit": unit,
         "vs_baseline": round(value / 20.0, 3),
     }
+    # every record carries the serving mesh it ran under — suites run in
+    # different FEI_TPU_MESH modes must never collide silently
+    try:
+        from fei_tpu.parallel.mesh import env_mesh_tag
+
+        line["mesh"] = env_mesh_tag()
+    except Exception:  # noqa: BLE001 — the headline number must survive
+        pass
     if extra:
         line.update(extra)
     if os.environ.get("FEI_TPU_BENCH_CPU_FALLBACK"):
@@ -648,6 +661,108 @@ def bench_moe(model: str, n_tokens: int) -> int:
     return bench_decode(model, n_tokens)
 
 
+def bench_sharded(model: str, n_tokens: int) -> int:
+    """The mesh-mode ladder: the SAME paged-serving workload at ms1, tp2
+    (and any further FEI_TPU_BENCH_MESH_LADDER rungs — tp4, tp2dp2, …).
+    Each rung reports aggregate tok/s AND its slot count, so dp replica
+    groups multiplying the scheduler's decode slots reads directly off
+    the ladder; each sharded rung also replays one greedy stream and
+    checks it token-identical to the ms1 reference (the serving mode's
+    bit-identity contract, docs/ENGINE.md "Mesh modes"). Rungs the host
+    cannot place (too few devices, tp not dividing the model's kv heads)
+    are SKIPPED LOUDLY — a silent drop would read as a covered rung."""
+    import threading
+
+    from fei_tpu.engine import GenerationConfig
+    from fei_tpu.parallel.mesh import env_mesh_tag
+
+    rungs = [
+        r.strip() for r in os.environ.get(
+            "FEI_TPU_BENCH_MESH_LADDER", "ms1,tp2,tp2dp2"
+        ).split(",") if r.strip()
+    ]
+    streams = int(os.environ.get("FEI_TPU_BENCH_STREAMS", "2"))
+    gen = GenerationConfig(
+        max_new_tokens=n_tokens, temperature=0.0, ignore_eos=True
+    )
+    prev_mesh = os.environ.get("FEI_TPU_MESH")
+    ladder: list[dict] = []
+    ref_tokens: list | None = None
+    try:
+        for rung in rungs:
+            os.environ["FEI_TPU_MESH"] = "" if rung == "ms1" else rung
+            try:
+                engine = _make_engine(
+                    model, max_seq_len=1024, paged=True,
+                    batch_size=streams, page_size=64,
+                )
+            except ValueError as exc:
+                log(f"bench: sharded rung {rung} SKIPPED: {exc}")
+                ladder.append({"mesh": rung, "skipped": str(exc)})
+                continue
+            prompt = _prompt(engine)
+            slots = engine.batch_size  # dp multiplies the configured slots
+
+            # one greedy stream first: the parity probe (and the warm-up
+            # that compiles the admit/decode programs)
+            toks = list(engine.scheduler.stream(prompt, gen))
+            if ref_tokens is None:
+                ref_tokens = toks
+            parity = toks == ref_tokens
+
+            counts = [0] * slots
+            errors: list = []
+
+            def consume(i, engine=engine, prompt=prompt, counts=counts,
+                        errors=errors):
+                try:
+                    counts[i] = sum(
+                        1 for _ in engine.scheduler.stream(prompt, gen)
+                    )
+                except BaseException as exc:  # noqa: BLE001 — re-raised
+                    errors.append(exc)
+
+            t0 = time.time()
+            threads = [
+                threading.Thread(target=consume, args=(i,))
+                for i in range(slots)
+            ]
+            [t.start() for t in threads]
+            [t.join() for t in threads]
+            if errors:
+                raise errors[0]
+            dt = time.time() - t0
+            agg = sum(counts) / dt
+            engine.scheduler.close()
+            del engine
+            tag = env_mesh_tag()
+            log(f"bench: sharded rung {rung} ({tag}): {slots} slots, "
+                f"{sum(counts)} tokens in {dt:.1f}s -> {agg:.1f} tok/s "
+                f"aggregate, greedy_parity={parity}")
+            ladder.append({
+                "mesh": tag, "slots": slots,
+                "agg_tok_s": round(agg, 2), "greedy_parity": parity,
+            })
+    finally:
+        if prev_mesh is None:
+            os.environ.pop("FEI_TPU_MESH", None)
+        else:
+            os.environ["FEI_TPU_MESH"] = prev_mesh
+
+    measured = [r for r in ladder if "agg_tok_s" in r]
+    if not measured:
+        raise RuntimeError(f"sharded ladder measured nothing: {ladder}")
+    if not all(r.get("greedy_parity") for r in measured):
+        raise RuntimeError(f"sharded ladder parity violated: {ladder}")
+    headline = measured[-1]  # the widest rung that actually ran
+    return _emit(
+        f"{_tag(model)}_sharded_{headline['mesh']}_agg_tok_s_per_chip",
+        headline["agg_tok_s"],
+        extra={"mesh": headline["mesh"], "ladder": ladder,
+               "streams_per_replica": streams},
+    )
+
+
 def bench_remote(n_tokens: int) -> int:
     """BASELINE config #1: the remote-client transport baseline — the full
     `fei --message` stack (Assistant → RemoteProvider → HTTP) against a
@@ -886,6 +1001,27 @@ def main() -> int:
             flags = (flags + " " + flag).strip()
         os.environ["XLA_FLAGS"] = flags
         os.execv(sys.executable, [sys.executable] + sys.argv)
+    if (
+        suite == "sharded"
+        and os.environ.get("FEI_TPU_SHARDED_READY") != "1"
+        and os.environ.get("JAX_PLATFORMS", "") == "cpu"
+    ):
+        # the CPU rehearsal of the mesh ladder needs an 8-device host
+        # mesh BEFORE jax initializes (same re-exec dance as federation);
+        # on a real TPU backend the ladder just uses the visible chips
+        os.environ["FEI_TPU_SHARDED_READY"] = "1"
+        import re as _re
+
+        flags = os.environ.get("XLA_FLAGS", "")
+        flag = "--xla_force_host_platform_device_count=8"
+        if "xla_force_host_platform_device_count" in flags:
+            flags = _re.sub(
+                r"--xla_force_host_platform_device_count=\d+", flag, flags
+            )
+        else:
+            flags = (flags + " " + flag).strip()
+        os.environ["XLA_FLAGS"] = flags
+        os.execv(sys.executable, [sys.executable] + sys.argv)
     if suite == "moe":
         default_model = "moe-2b"
     elif suite == "decode":
@@ -927,6 +1063,8 @@ def main() -> int:
         return bench_prefill(model, n_tokens)
     if suite == "paged":
         return bench_paged(model, n_tokens)
+    if suite == "sharded":
+        return bench_sharded(model, n_tokens)
     if suite == "moe":
         return bench_moe(model, n_tokens)
     if suite == "agent":

@@ -128,20 +128,36 @@ def restore_checkpoint(
 # differently-built binary after a deploy.
 
 _SNAPSHOT_FILE = "requests.json"
-_SNAPSHOT_VERSION = 1
+# v1: {version, requests}; v2 adds the serving mesh geometry — resume
+# replays KV through the same collective layout it was produced on, so a
+# warm restart onto a different mesh must refuse instead of silently
+# breaking byte-identity
+_SNAPSHOT_VERSION = 2
+_LEGACY_VERSIONS = (1,)
 
 
-def save_request_snapshots(directory: str, snaps: list[dict]) -> None:
+def save_request_snapshots(
+    directory: str, snaps: list[dict], mesh: dict | None = None
+) -> None:
     """Atomically persist drain-time request snapshots (tmp + rename, the
-    same torn-write discipline as the pipeline reports)."""
+    same torn-write discipline as the pipeline reports). ``mesh`` is the
+    draining engine's serialized geometry (parallel.mesh.mesh_geometry);
+    None records the single-chip layout."""
     import json
+
+    from fei_tpu.parallel.mesh import mesh_geometry
 
     os.makedirs(directory, exist_ok=True)
     path = os.path.join(directory, _SNAPSHOT_FILE)
     tmp = path + ".tmp"
+    payload = {
+        "version": _SNAPSHOT_VERSION,
+        "mesh": mesh if mesh is not None else mesh_geometry(None),
+        "requests": snaps,
+    }
     try:
         with open(tmp, "w", encoding="utf-8") as f:
-            json.dump({"version": _SNAPSHOT_VERSION, "requests": snaps}, f)
+            json.dump(payload, f)
         os.replace(tmp, path)
     except OSError as exc:
         raise CheckpointError(
@@ -151,12 +167,19 @@ def save_request_snapshots(directory: str, snaps: list[dict]) -> None:
     log.info("saved %d request snapshots -> %s", len(snaps), path)
 
 
-def load_request_snapshots(directory: str) -> list[dict]:
+def load_request_snapshots(
+    directory: str, expect_mesh: dict | None = None
+) -> list[dict]:
     """Load persisted request snapshots; [] when none were saved. A
     corrupt or future-versioned file raises CheckpointError — silently
     dropping accepted requests is the failure mode this exists to
-    prevent."""
+    prevent. ``expect_mesh`` (the restoring engine's geometry) refuses a
+    file drained on a different mesh: resumed KV must rebuild through the
+    same collective layout to stay byte-identical. Version-1 files carry
+    no geometry and are treated as single-chip drains."""
     import json
+
+    from fei_tpu.parallel.mesh import mesh_geometry
 
     path = os.path.join(directory, _SNAPSHOT_FILE)
     if not os.path.exists(path):
@@ -169,11 +192,22 @@ def load_request_snapshots(directory: str) -> list[dict]:
             f"could not read request snapshots from {path}: {exc}",
             cause=exc,
         )
-    if data.get("version") != _SNAPSHOT_VERSION:
+    version = data.get("version")
+    if version != _SNAPSHOT_VERSION and version not in _LEGACY_VERSIONS:
         raise CheckpointError(
-            f"request snapshot version {data.get('version')!r} in {path} "
+            f"request snapshot version {version!r} in {path} "
             f"is not the supported version {_SNAPSHOT_VERSION}"
         )
+    if expect_mesh is not None:
+        saved = data.get("mesh") or mesh_geometry(None)
+        if {k: int(v) for k, v in saved.items()} != expect_mesh:
+            raise CheckpointError(
+                f"request snapshots in {path} were drained on mesh "
+                f"{saved}, but this engine serves mesh {expect_mesh}; "
+                "warm restart onto a mismatched mesh would break "
+                "byte-identical resume — restore on the matching mesh "
+                "or resubmit the requests"
+            )
     return list(data.get("requests", []))
 
 

@@ -195,12 +195,25 @@ class InferenceEngine:
         axis, which would split nibble pairs across devices; the
         column-parallel ones run the kernel under shard_map
         (ops.pallas.int4_matmul.int4_mm_sharded via models.llama._mm_k)."""
+        from fei_tpu.parallel.mesh import axis_size, has_axis, mesh_from_env
+
         if quantize not in (None, "int8", "int4"):
             raise ValueError(f"unsupported quantize mode: {quantize!r}")
-        int4_exclude = frozenset()
-        if quantize == "int4" and mesh is not None and mesh.shape.get("tp", 1) > 1:
-            int4_exclude = frozenset({"wo", "w_down"})
         cfg = get_model_config(name, **overrides)
+        env_mesh = mesh is None
+        if env_mesh:
+            # FEI_TPU_MESH promotes the sharded path to the serving mode
+            # without touching call sites (providers, bench, the server)
+            mesh = mesh_from_env(
+                num_kv_heads=cfg.num_kv_heads, num_experts=cfg.num_experts
+            )
+        if paged and has_axis(mesh, "dp"):
+            # dp replica groups multiply the aggregate decode slots: each
+            # group serves batch_size slots of the (batch-sharded) pool
+            batch_size *= axis_size(mesh, "dp")
+        int4_exclude = frozenset()
+        if quantize == "int4" and has_axis(mesh, "tp"):
+            int4_exclude = frozenset({"wo", "w_down"})
         tok = load_tokenizer(tokenizer)
         if checkpoint_dir:
             from fei_tpu.engine.weights import load_checkpoint
@@ -225,24 +238,36 @@ class InferenceEngine:
             long_prefill_min=long_prefill_min,
         )
         if mesh is not None:
+            import os
+
             from fei_tpu.parallel.sharding import shard_engine
 
             if checkpoint_dir:
                 engine.mesh = mesh  # params already landed sharded
             else:
-                shard_engine(engine, mesh)
+                # the FEI_TPU_MESH serving mode defaults to replicated
+                # weights — sharded decode stays token-identical to the
+                # single-chip engine (Megatron psums reorder summation and
+                # flip near-tie greedy argmax). FEI_TPU_MESH_WEIGHTS=
+                # sharded opts into the throughput tables; an explicitly
+                # passed mesh keeps the historical sharded behavior.
+                weights = os.environ.get(
+                    "FEI_TPU_MESH_WEIGHTS",
+                    "replicated" if env_mesh else "sharded",
+                )
+                shard_engine(engine, mesh, weights=weights)
         return engine
 
     # -- compiled programs --------------------------------------------------
 
     def _moe_mesh(self):
         """The mesh for token-routed EP inside the model forward, or None
-        when there is no ep axis (single chip / pure TP-DP meshes)."""
-        if (
-            self.mesh is not None
-            and self.cfg.is_moe
-            and self.mesh.shape.get("ep", 1) > 1
-        ):
+        when there is no ep axis (single chip / pure TP-DP meshes). Mesh
+        detection goes through parallel.mesh.has_axis — the one helper
+        that treats mesh=None as the all-ones mesh."""
+        from fei_tpu.parallel.mesh import has_axis
+
+        if self.cfg.is_moe and has_axis(self.mesh, "ep"):
             return self.mesh
         return None
 
@@ -505,23 +530,12 @@ class InferenceEngine:
                 kv_quant=self.kv_quant,
             )
             if self.mesh is not None:
-                # kv heads shard over tp (mirrors the dense cache layout);
-                # tables/lengths replicate. The paged kernel runs under
-                # shard_map on this layout (forward_paged kernel_mesh)
-                from jax.sharding import NamedSharding, PartitionSpec as P
+                # declarative pool layout (parallel.sharding): kv heads
+                # over tp, tables/lengths replicated; the paged kernel's
+                # shard_map wrapper slices batch rows over dp per dispatch
+                from fei_tpu.parallel.sharding import shard_paged_pool
 
-                page_s = NamedSharding(self.mesh, P(None, None, "tp", None, None))
-                rep = NamedSharding(self.mesh, P())
-                self._pool = self._pool._replace(
-                    k_pages=jax.device_put(self._pool.k_pages, page_s),
-                    v_pages=jax.device_put(self._pool.v_pages, page_s),
-                    block_table=jax.device_put(self._pool.block_table, rep),
-                    lengths=jax.device_put(self._pool.lengths, rep),
-                    k_scales=None if self._pool.k_scales is None else
-                    jax.device_put(self._pool.k_scales, page_s),
-                    v_scales=None if self._pool.v_scales is None else
-                    jax.device_put(self._pool.v_scales, page_s),
-                )
+                self._pool = shard_paged_pool(self._pool, self.mesh)
         if self._allocator is None:
             self._allocator = PageAllocator(num_pages, self.page_size)
         return self._pool
@@ -565,8 +579,13 @@ class InferenceEngine:
             clear_request_snapshots,
             load_request_snapshots,
         )
+        from fei_tpu.parallel.mesh import mesh_geometry
 
-        snaps = load_request_snapshots(snapshot_dir)
+        # refuses (CheckpointError) when the snapshots were drained on a
+        # different mesh geometry than this engine serves
+        snaps = load_request_snapshots(
+            snapshot_dir, expect_mesh=mesh_geometry(self.mesh)
+        )
         if not snaps:
             return []
         clear_request_snapshots(snapshot_dir)

@@ -10,6 +10,8 @@ PagedScheduler state — see sched_admission.py for the rationale.
 
 from __future__ import annotations
 
+import time
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -414,12 +416,29 @@ class DecodeMixin:
         METRICS.incr("scheduler.decode_steps", n)
         METRICS.incr("scheduler.decode_slot_steps", len(active) * n)
         METRICS.gauge("scheduler.batch_slots_active", len(active))
+        t0 = time.perf_counter()
         with METRICS.span("decode_step"):
             nxt, self._step_keys, self._pool, self._keys = step(*args, **kw)
             out = np.asarray(nxt)  # host sync inside the span
+        self._record_collective_time(time.perf_counter() - t0)
         for _, s in active:
             s.shield = False  # survived a dispatch: victimizable again
         return out
+
+    def _record_collective_time(self, dt: float) -> None:
+        """Attribute a sharded dispatch's wall time to each active mesh
+        axis (collective.<axis>_seconds histograms). Without an on-device
+        profiler this is an upper bound — the step includes compute — but
+        a per-axis regression (a tp4 step suddenly 2x a tp2 step at equal
+        batch) still reads directly off the histogram deltas."""
+        from fei_tpu.parallel.mesh import AXES, axis_size
+
+        mesh = self.engine.mesh
+        if mesh is None:
+            return
+        for ax in AXES:
+            if axis_size(mesh, ax) > 1:
+                METRICS.timing(f"collective.{ax}", dt)
 
 
     def _multi_fn(self, n_steps: int, grammared: bool, masked: bool = False):

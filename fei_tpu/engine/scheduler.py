@@ -384,7 +384,9 @@ class PagedScheduler(AdmissionMixin, DecodeMixin, ConstraintMixin):
         dl = getattr(gen, "deadline_s", 0.0) or self.default_deadline_s
         if dl > 0:
             seq.deadline = seq.t_queued + dl
-        seq.trace = TRACES.start(prompt_tokens=n)
+        from fei_tpu.parallel.mesh import mesh_tag
+
+        seq.trace = TRACES.start(prompt_tokens=n, mesh=mesh_tag(eng.mesh))
         seq.rid = seq.trace.rid
         if _restore is not None:
             # warm restart: rebuild the preempt-resume state BEFORE the seq
@@ -736,12 +738,38 @@ class PagedScheduler(AdmissionMixin, DecodeMixin, ConstraintMixin):
         METRICS.incr(f"scheduler.requests_{status}")
 
     def _update_sched_gauges(self) -> None:
-        """Occupancy gauges: queue depth, running slots, page pool."""
+        """Occupancy gauges: queue depth, running slots, page pool, mesh
+        shape, and per-dp-replica occupancy."""
+        from fei_tpu.parallel.mesh import AXES, axis_size
+
         METRICS.gauge("scheduler.queue_depth", len(self._waiting))
         METRICS.gauge(
             "scheduler.running_slots",
             sum(1 for s in self._slots if s is not None),
         )
+        mesh = self.engine.mesh
+        METRICS.gauge(
+            "engine.mesh_shape",
+            int(np.prod([axis_size(mesh, ax) for ax in AXES])),
+        )
+        for ax in AXES:
+            METRICS.gauge(f"engine.mesh.{ax}", axis_size(mesh, ax))
+        dp = axis_size(mesh, "dp")
+        if dp > 1 and self.B % dp == 0:
+            # batch rows stripe over dp groups in contiguous blocks (the
+            # leading-axis device layout the kernel wrapper shards by)
+            per = self.B // dp
+            waiting = len(self._waiting)
+            for g in range(dp):
+                occupied = sum(
+                    1 for s in self._slots[g * per:(g + 1) * per]
+                    if s is not None
+                )
+                METRICS.gauge(f"scheduler.replica.{g}.slots", occupied)
+                METRICS.gauge(
+                    f"scheduler.replica.{g}.queue_depth",
+                    waiting // dp + (1 if g < waiting % dp else 0),
+                )
         alloc = getattr(self.engine, "_allocator", None)
         if alloc is not None:
             total = alloc.num_pages - 1  # page 0 is the reserved null page
@@ -1047,9 +1075,13 @@ class PagedScheduler(AdmissionMixin, DecodeMixin, ConstraintMixin):
             s.out.put(_DONE)
         if snaps and self._drain_dir:
             from fei_tpu.engine import checkpoint
+            from fei_tpu.parallel.mesh import mesh_geometry
 
             try:
-                checkpoint.save_request_snapshots(self._drain_dir, snaps)
+                checkpoint.save_request_snapshots(
+                    self._drain_dir, snaps,
+                    mesh=mesh_geometry(self.engine.mesh),
+                )
             except Exception as exc:  # noqa: BLE001
                 log.error("drain snapshot persistence failed: %r", exc)
         self._update_sched_gauges()
@@ -1072,6 +1104,8 @@ class PagedScheduler(AdmissionMixin, DecodeMixin, ConstraintMixin):
             return None
         from dataclasses import asdict
 
+        from fei_tpu.parallel.mesh import mesh_geometry
+
         gen = asdict(seq.gen)
         gen["stop_token_ids"] = list(gen.get("stop_token_ids") or ())
         snap = {
@@ -1082,6 +1116,11 @@ class PagedScheduler(AdmissionMixin, DecodeMixin, ConstraintMixin):
                 None if seq.resume_key is None
                 else [int(x) for x in np.asarray(seq.resume_key).tolist()]
             ),
+            # byte-identical resume replays KV through the same collective
+            # layout it was produced on — a different mesh (like a
+            # different page_size) changes summation order, so the
+            # geometry rides along and restore refuses a mismatch
+            "mesh": mesh_geometry(self.engine.mesh),
             "gen": gen,
         }
         if seq.deadline:

@@ -578,7 +578,12 @@ def forward_paged_block(
     cos, sin = compute_rope_freqs(cfg.rope_dim_, max_pos, cfg.rope_theta)
     # kernel-selection policy: see the docstring
     block_kernel = T > 1 and os.environ.get("FEI_TPU_BLOCK_ATTN", "1") != "0"
-    sharded = kernel_mesh is not None and kernel_mesh.shape.get("tp", 1) > 1
+    # any sharding axis (tp heads OR dp batch groups) must lift the pallas
+    # kernel through shard_map — XLA cannot auto-partition a pallas_call
+    sharded = kernel_mesh is not None and (
+        kernel_mesh.shape.get("tp", 1) > 1
+        or kernel_mesh.shape.get("dp", 1) > 1
+    )
     win = cfg.sliding_window or 0
 
     kv_int8 = cache.k_scales is not None

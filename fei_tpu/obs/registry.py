@@ -129,6 +129,18 @@ METRIC_REGISTRY: dict[str, tuple[str, str]] = {
                         "1 once a graceful drain began (sticky for the "
                         "process lifetime), else 0."),
     "scheduler.running_slots": ("gauge", "Sequences actively decoding."),
+    "engine.mesh_shape": ("gauge",
+                          "Devices in the serving mesh (1 = single chip); "
+                          "per-axis sizes in engine.mesh.*."),
+    "engine.mesh.*": ("gauge",
+                      "Serving-mesh axis size (dp/tp/ep/sp/pp family; 1 = "
+                      "axis unused)."),
+    "scheduler.replica.*.slots": ("gauge",
+                                  "Active decode slots in one dp replica "
+                                  "group's batch slice."),
+    "scheduler.replica.*.queue_depth": (
+        "gauge", "Waiting requests attributed to one dp replica group "
+                 "(balanced share of the shared admission queue)."),
     "scheduler.batch_slots_active": ("gauge",
                                      "Active slots in the last decode "
                                      "dispatch (batch utilization)."),
@@ -149,6 +161,11 @@ METRIC_REGISTRY: dict[str, tuple[str, str]] = {
     "agent.completion": ("span", "One LLM call from the assistant loop."),
     "provider.jax_local": ("span", "One local-engine provider call."),
     "tool.*": ("span", "One tool execution (per-tool family)."),
+    "collective.*": ("span",
+                     "Sharded decode-dispatch wall time attributed to one "
+                     "active mesh axis (per-axis family; an upper bound "
+                     "on that axis's collective time — the step includes "
+                     "compute)."),
     # --- histograms (observed directly, not via span) -------------------
     "ttft_seconds": ("histogram",
                      "Time from submit to first emitted token."),

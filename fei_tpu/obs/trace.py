@@ -39,6 +39,9 @@ class RequestTrace:
     prompt_tokens: int = 0
     completion_tokens: int = 0
     status: str = "active"
+    # serving-mesh tag ("ms1", "tp2", "tp2dp2", …) — post-hoc tail-latency
+    # debugging needs to know which mesh mode served the request
+    mesh: str = "ms1"
     events: list = field(default_factory=list)  # [(phase, ts), ...]
 
     def event(self, phase: str) -> None:
@@ -57,6 +60,7 @@ class RequestTrace:
             "status": self.status,
             "prompt_tokens": self.prompt_tokens,
             "completion_tokens": self.completion_tokens,
+            "mesh": self.mesh,
             "duration_s": round(dur, 6),
             "spans": spans,
         }
@@ -74,9 +78,10 @@ class TraceBuffer:
         self._lock = threading.Lock()
         self._ring: deque[RequestTrace] = deque(maxlen=max(1, maxlen))
 
-    def start(self, prompt_tokens: int = 0) -> RequestTrace:
+    def start(self, prompt_tokens: int = 0, mesh: str = "ms1") -> RequestTrace:
         tr = RequestTrace(
-            rid=f"req-{uuid.uuid4().hex[:12]}", prompt_tokens=prompt_tokens
+            rid=f"req-{uuid.uuid4().hex[:12]}", prompt_tokens=prompt_tokens,
+            mesh=mesh,
         )
         tr.event("queued")
         with self._lock:

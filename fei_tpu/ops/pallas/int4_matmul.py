@@ -47,6 +47,7 @@ _CompilerParams = getattr(pltpu, "CompilerParams", None) or getattr(
 
 from fei_tpu.ops.quant import QTensor4, unpack4
 from fei_tpu.utils.logging import get_logger
+from fei_tpu.utils.platform import shard_map
 
 log = get_logger("ops.int4")
 
@@ -271,7 +272,7 @@ def int4_mm_sharded(
     def body(x_loc, p_loc, s_loc):  # names must not shadow the pallas `pl`
         return int4_mm(x_loc, QTensor4(p=p_loc, s=s_loc))
 
-    fn = jax.shard_map(
+    fn = shard_map(
         body, mesh=mesh, in_specs=(x_spec, w_spec, w_spec),
         out_specs=out_spec,
         check_vma=False,  # the vma checker can't see through a pallas_call

@@ -30,6 +30,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from fei_tpu.utils.platform import shard_map
+
 # jax renamed pltpu.TPUCompilerParams -> CompilerParams (jax 0.5); alias so
 # the kernels run on both API generations
 _CompilerParams = getattr(pltpu, "CompilerParams", None) or getattr(
@@ -296,19 +298,39 @@ def _sharded_paged(
     local_fn,
     head_spec,
     q, k_pages, v_pages, block_table, lengths, mesh, axis_name,
-    k_scales, v_scales, window=0,
+    k_scales, v_scales, window=0, dp_axis="dp",
 ):
     """Shared shard_map wrapper: XLA cannot auto-partition a pallas_call,
     so kv heads (and the query head groups attending to them) shard over
-    ``axis_name`` and each device runs the kernel on its local pool slice."""
+    ``axis_name`` and each device runs the kernel on its local pool slice.
+
+    A ``dp_axis`` of size > 1 additionally splits the batch rows across dp
+    replica groups when the batch divides evenly — each group attends its
+    own slot slice against the (replicated) page pool, which is what lets
+    dp multiply the scheduler's aggregate decode slots. Attention rows are
+    independent, so the split is numerics-neutral.
+
+    The per-device head outputs are all-gathered INSIDE the shard_map and
+    the result leaves replicated over ``axis_name``. Emitting a
+    head-sharded output instead would let GSPMD partition the following
+    ``wo`` contraction (heads fold into the contracted dim) into a psum —
+    a different summation order than the single-chip matmul, which flips
+    greedy argmax on near-tie logits. The gather is pure data movement, so
+    sharded decode stays bit-identical to single-chip."""
     from jax.sharding import PartitionSpec as P
 
-    n = mesh.shape[axis_name]
+    n = mesh.shape.get(axis_name, 1)
     K = k_pages.shape[1]
     if K % n:
         raise ValueError(f"kv heads {K} must divide {axis_name} axis {n}")
+    dp = mesh.shape.get(dp_axis, 1)
+    batch_axis = dp_axis if (dp > 1 and q.shape[0] % dp == 0) else None
+    head_axis = tuple(head_spec).index(axis_name)  # q's head dim position
+    head_spec = P(batch_axis, *tuple(head_spec)[1:])
+    out_spec = P(batch_axis)  # heads replicated after the in-body gather
     page_spec = P(None, axis_name, None, None)
-    in_specs = [head_spec, page_spec, page_spec, P(), P()]
+    in_specs = [head_spec, page_spec, page_spec,
+                P(batch_axis), P(batch_axis)]
     args = [q, k_pages, v_pages, block_table, lengths]
     if k_scales is not None:
         in_specs += [page_spec, page_spec]
@@ -316,12 +338,17 @@ def _sharded_paged(
 
     def body(q, kp, vp, bt, ln, *scales):
         ks, vs = scales if scales else (None, None)
-        return local_fn(
+        out = local_fn(
             q, kp, vp, bt, ln, k_scales=ks, v_scales=vs, window=window
         )
+        if n > 1:
+            out = jax.lax.all_gather(
+                out, axis_name, axis=head_axis, tiled=True
+            )
+        return out
 
-    fn = jax.shard_map(
-        body, mesh=mesh, in_specs=tuple(in_specs), out_specs=head_spec,
+    fn = shard_map(
+        body, mesh=mesh, in_specs=tuple(in_specs), out_specs=out_spec,
         # the vma checker can't see through a pallas_call's output
         check_vma=False,
     )

@@ -17,15 +17,42 @@ chip, and a v5e-64 pod.
 
 from __future__ import annotations
 
+import os
+import re
+
 import jax
 import numpy as np
 from jax.sharding import Mesh
 
 AXES = ("dp", "tp", "ep", "sp", "pp")
 
+# env knob that promotes the sharded paged path to the engine's serving
+# mode: FEI_TPU_MESH=tp2 / tp2dp2 / "dp=2,tp=4" / auto; unset (or ms1 /
+# single / off) keeps the single-chip path
+MESH_ENV = "FEI_TPU_MESH"
+
+# "single-chip" spellings: the ms1 tag is what bench ladders print for the
+# unsharded arm, so it round-trips through FEI_TPU_MESH too
+_SINGLE = ("", "0", "off", "none", "single", "ms1")
+
+_COMPACT_RX = re.compile(r"(dp|tp|ep|sp|pp)(\d+)")
+
 
 def parse_mesh_shape(spec: str) -> dict[str, int]:
-    """Parse 'dp=2,tp=4' into {'dp': 2, 'tp': 4}."""
+    """Parse a mesh spec string into an axis-size dict.
+
+    Two spellings are accepted: the explicit 'dp=2,tp=4' form and the
+    compact env-friendly 'tp4dp2' form ('FEI_TPU_MESH=tp2dp1').
+    """
+    spec = spec.strip()
+    if "=" not in spec and spec:
+        matches = list(_COMPACT_RX.finditer(spec))
+        if not matches or "".join(m.group(0) for m in matches) != spec:
+            raise ValueError(
+                f"unparseable mesh spec {spec!r}; expected 'tp2dp2' or "
+                f"'dp=2,tp=2' over axes {AXES}"
+            )
+        return {m.group(1): int(m.group(2)) for m in matches}
     out: dict[str, int] = {}
     for part in spec.split(","):
         part = part.strip()
@@ -83,3 +110,103 @@ def make_mesh(
 
 def single_device_mesh() -> Mesh:
     return make_mesh({"tp": 1}, devices=jax.devices()[:1])
+
+
+# -- engine-facing helpers ---------------------------------------------------
+#
+# Everything below treats mesh=None (the single-chip engine) as the
+# (1,1,1,1,1) mesh, so callers never branch on "is there a mesh" — ISSUE 6's
+# ad-hoc `self.mesh is not None and self.mesh.shape.get(...)` checks all
+# collapse into axis_size()/has_axis().
+
+
+def axis_size(mesh: Mesh | None, name: str) -> int:
+    """Size of a mesh axis; 1 for a missing axis or no mesh at all."""
+    if mesh is None:
+        return 1
+    return int(mesh.shape.get(name, 1))
+
+
+def has_axis(mesh: Mesh | None, name: str) -> bool:
+    """True when the axis exists with size > 1 (i.e. it actually shards)."""
+    return axis_size(mesh, name) > 1
+
+
+def mesh_geometry(mesh: Mesh | None) -> dict[str, int]:
+    """Canonical serializable geometry {axis: size} over ALL axes (size-1
+    included), identical for mesh=None and an all-ones mesh — the snapshot
+    compatibility key for preempt/resume and warm restart."""
+    return {ax: axis_size(mesh, ax) for ax in AXES}
+
+
+def mesh_tag(mesh: Mesh | None) -> str:
+    """Compact human tag: 'ms1' for single-chip, else e.g. 'tp2dp2'
+    (sharding axes only, canonical order) — bench ladders and /health."""
+    parts = [f"{ax}{axis_size(mesh, ax)}" for ax in AXES
+             if axis_size(mesh, ax) > 1]
+    return "".join(parts) if parts else "ms1"
+
+
+def env_mesh_tag(env: str | None = None) -> str:
+    """The canonical tag ('ms1', 'tp2dp2', …) the CURRENT environment's
+    FEI_TPU_MESH spec denotes, without building a mesh — bench lines and
+    logs stamp it on every record so suites run under different serving
+    modes never collide. Unresolvable specs come back verbatim rather
+    than raising: a tagging helper must never sink the caller."""
+    spec = env if env is not None else os.environ.get(MESH_ENV, "")
+    spec = spec.strip().lower()
+    if spec in _SINGLE:
+        return "ms1"
+    try:
+        if spec == "auto":
+            shape = best_mesh_shape(len(jax.devices()))
+        else:
+            shape = parse_mesh_shape(spec)
+    except Exception:  # noqa: BLE001 — tagging must never raise
+        return spec
+    parts = [f"{ax}{int(shape[ax])}" for ax in AXES
+             if int(shape.get(ax, 1)) > 1]
+    return "".join(parts) if parts else "ms1"
+
+
+def mesh_from_env(
+    num_kv_heads: int = 8,
+    num_experts: int = 0,
+    devices=None,
+    env: str | None = None,
+) -> Mesh | None:
+    """The mesh requested by ``FEI_TPU_MESH``, or None for single-chip.
+
+    - unset / '' / 'ms1' / 'single' / 'off': None (single-chip path)
+    - 'auto': best_mesh_shape over all visible devices
+    - 'tp2', 'tp2dp2', 'dp=2,tp=4': explicit shape; uses the first
+      prod(sizes) visible devices so a shape smaller than the host's
+      device count is legal (tp2 on the 8-device CPU test mesh).
+    """
+    spec = env if env is not None else os.environ.get(MESH_ENV, "")
+    spec = spec.strip().lower()
+    if spec in _SINGLE:
+        return None
+    devices = devices if devices is not None else jax.devices()
+    if spec == "auto":
+        shape = best_mesh_shape(
+            len(devices), num_kv_heads=num_kv_heads, num_experts=num_experts
+        )
+    else:
+        shape = parse_mesh_shape(spec)
+    sizes = [int(shape.get(ax, 1)) for ax in AXES]
+    need = int(np.prod(sizes))
+    if need > len(devices):
+        raise ValueError(
+            f"{MESH_ENV}={spec!r} needs {need} devices, have {len(devices)}"
+        )
+    tp = int(shape.get("tp", 1))
+    if tp > 1 and num_kv_heads % tp:
+        # fail at engine construction, not deep inside the first dispatch
+        raise ValueError(
+            f"{MESH_ENV}={spec!r}: tp={tp} must divide the model's "
+            f"{num_kv_heads} kv heads (the page pool shards over them)"
+        )
+    if need == 1:
+        return None
+    return make_mesh(shape, devices=devices[:need])

@@ -27,6 +27,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from fei_tpu.utils.platform import pcast, shard_map
+
 NEG_INF = -1e30
 
 
@@ -88,11 +90,11 @@ def _ring_attention_shard(
     q_pos = my_idx * C + jnp.arange(C)
 
     # init state is device-varying (the loop writes per-device values into it)
-    m0 = jax.lax.pcast(
+    m0 = pcast(
         jnp.full((B, C, H, 1), NEG_INF, dtype=jnp.float32), axis_name, to="varying"
     )
-    l0 = jax.lax.pcast(jnp.zeros((B, C, H, 1), dtype=jnp.float32), axis_name, to="varying")
-    acc0 = jax.lax.pcast(jnp.zeros((B, C, H, D), dtype=jnp.float32), axis_name, to="varying")
+    l0 = pcast(jnp.zeros((B, C, H, 1), dtype=jnp.float32), axis_name, to="varying")
+    acc0 = pcast(jnp.zeros((B, C, H, D), dtype=jnp.float32), axis_name, to="varying")
     perm = [(i, (i + 1) % n) for i in range(n)]
 
     def body(step, carry):
@@ -152,7 +154,7 @@ def ring_attention(
     if scale is None:
         scale = D ** -0.5
     spec = P(None, axis_name, None, None)
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(
             _ring_attention_shard, axis_name=axis_name, scale=scale,
             window=window,
@@ -216,7 +218,7 @@ def ulysses_attention(
     if scale is None:
         scale = D ** -0.5
     spec = P(None, axis_name, None, None)
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(
             _ulysses_shard, axis_name=axis_name, scale=scale, window=window
         ),

@@ -19,58 +19,118 @@ mesh and a v5e pod.
 
 from __future__ import annotations
 
+import re
+
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# -- declarative per-family sharding rules (SNIPPETS.md [3] idiom) -----------
+#
+# A rule table is an ordered (regex, PartitionSpec) sequence matched against
+# the '/'-joined path of each param leaf; FIRST match wins, so family
+# overrides (MoE's ep-sharded experts) sit above the dense defaults. Adding
+# a model family means adding a table — not editing tree-construction code.
+
+DENSE_RULES: tuple[tuple[str, P], ...] = (
+    # embed [V, H]: vocab over tp (logits psum-free; gather at sample)
+    (r"embed$", P("tp", None)),
+    # attention column split: output (head) dim over tp
+    (r"layers/(wq|wk|wv)$", P(None, None, "tp")),
+    # qkv biases follow their projection's column (head-dim) split;
+    # b_gate is Phi fc1's bias, same column contract
+    (r"layers/(bq|bk|bv|b_gate)$", P(None, "tp")),
+    # wo row split: input (head) dim over tp — one psum per block
+    (r"layers/wo$", P(None, "tp", None)),
+    # MLP column/row split over the intermediate dim
+    (r"layers/(w_gate|w_up)$", P(None, None, "tp")),
+    (r"layers/w_down$", P(None, "tp", None)),
+    (r"lm_head$", P(None, "tp")),
+    (r"lm_head_b$", P("tp")),  # follows the head's vocab split
+    # everything else replicates: norms + their biases (tiny), b_down/bo
+    # (bias of a psummed row-parallel output adds once), router
+    (r".*", P()),
+)
+
+MOE_RULES: tuple[tuple[str, P], ...] = (
+    (r"layers/router$", P()),
+    # experts [L, E, ...]: E over ep, then the intermediate dim over tp
+    (r"layers/(w_gate|w_up)$", P(None, "ep", None, "tp")),
+    (r"layers/w_down$", P(None, "ep", "tp", None)),
+) + DENSE_RULES
+
+
+# the bit-exact serving profile: weights replicate onto the mesh (every
+# device holds the full tensor) so every matmul runs with the single-chip
+# contraction order — only the attention kernel (kv heads over tp, batch
+# rows over dp) and the page pool shard. Megatron column/row splits change
+# the summation order (psum of partials), which flips greedy argmax on
+# near-tie logits; FEI_TPU_MESH serving mode therefore defaults to this
+# table and opts into the Megatron tables via FEI_TPU_MESH_WEIGHTS=sharded.
+REPLICATED_RULES: tuple[tuple[str, P], ...] = (
+    (r".*", P()),
+)
+
+
+def partition_rules(is_moe: bool) -> tuple[tuple[str, P], ...]:
+    """The rule table for a model family."""
+    return MOE_RULES if is_moe else DENSE_RULES
+
+
+def match_partition_rules(rules, tree: dict) -> dict:
+    """Map a param pytree to a congruent PartitionSpec pytree by matching
+    each leaf's '/'-joined path against ``rules`` (first match wins).
+    Quantized leaves (QTensor/QTensor4) are treated as leaves — their
+    component specs derive from the matched weight spec downstream. A
+    path no rule covers raises: silent replication of a 10-GB tensor is
+    the bug this is guarding against."""
+
+    def spec_for(path: str) -> P:
+        for rx, spec in rules:
+            if re.search(rx, path):
+                return spec
+        raise ValueError(f"no partition rule matches param {path!r}")
+
+    def walk(prefix: str, sub):
+        if isinstance(sub, dict):
+            return {
+                k: walk(f"{prefix}/{k}" if prefix else k, v)
+                for k, v in sub.items()
+            }
+        return spec_for(prefix)
+
+    return walk("", tree)
 
 
 def param_specs(
     is_moe: bool, attn_bias: bool = False, o_bias: bool = False
 ) -> dict:
-    """PartitionSpec pytree matching models/llama.py's param layout."""
-    layers = {
-        "attn_norm": P(),
-        "wq": P(None, None, "tp"),
-        "wk": P(None, None, "tp"),
-        "wv": P(None, None, "tp"),
-        "wo": P(None, "tp", None),
-        "mlp_norm": P(),
-        # Phi-family leaves (harmless extras for other models — the
-        # matcher only reads specs for keys the param tree actually has):
-        # LayerNorm biases replicate; fc1's bias follows its column split;
-        # fc2's bias adds once to the psummed row-parallel output
-        "attn_norm_b": P(),
-        "mlp_norm_b": P(),
-        "b_gate": P(None, "tp"),
-        "b_down": P(),
-    }
+    """PartitionSpec pytree matching models/llama.py's param layout.
+
+    The key template only controls WHICH leaves exist (Phi extras are
+    harmless for other models — the matcher reads specs for keys the
+    param tree actually has); every spec comes from the family rule
+    table, so this stays consistent with match_partition_rules on a real
+    param tree by construction."""
+    layers = dict.fromkeys([
+        "attn_norm", "wq", "wk", "wv", "wo", "mlp_norm",
+        "attn_norm_b", "mlp_norm_b", "b_gate", "b_down",
+        "w_gate", "w_up", "w_down",
+    ])
     if attn_bias:
-        # qkv biases follow their projection's column (head-dim) split
-        layers.update(bq=P(None, "tp"), bk=P(None, "tp"), bv=P(None, "tp"))
+        layers.update(dict.fromkeys(["bq", "bk", "bv"]))
     if o_bias:
-        # wo is row-parallel (contraction over tp); its bias adds once to
-        # the psummed output, so it replicates
-        layers["bo"] = P()
+        layers["bo"] = None
     if is_moe:
-        layers.update(
-            router=P(),
-            w_gate=P(None, "ep", None, "tp"),
-            w_up=P(None, "ep", None, "tp"),
-            w_down=P(None, "ep", "tp", None),
-        )
-    else:
-        layers.update(
-            w_gate=P(None, None, "tp"),
-            w_up=P(None, None, "tp"),
-            w_down=P(None, "tp", None),
-        )
-    return {
-        "embed": P("tp", None),
+        layers["router"] = None
+    template = {
+        "embed": None,
         "layers": layers,
-        "final_norm": P(),
-        "final_norm_b": P(),
-        "lm_head": P(None, "tp"),
-        "lm_head_b": P("tp"),  # follows the head's vocab split
+        "final_norm": None,
+        "final_norm_b": None,
+        "lm_head": None,
+        "lm_head_b": None,
     }
+    return match_partition_rules(partition_rules(is_moe), template)
 
 
 def _scale_spec(spec: P, s_shape: tuple) -> P:
@@ -128,10 +188,18 @@ def _tree_shardings(specs: dict, params: dict, mesh: Mesh) -> dict:
     return pick(specs, params)
 
 
-def param_shardings(params: dict, mesh: Mesh, is_moe: bool) -> dict:
-    layers = params.get("layers", {})
+def param_shardings(
+    params: dict, mesh: Mesh, is_moe: bool, rules=None
+) -> dict:
+    """NamedSharding tree for an actual param pytree: the family rule
+    table matched directly against the tree's own paths, so absent leaves
+    (tied lm_head) and extra leaves never need template bookkeeping.
+    ``rules`` overrides the family table (e.g. REPLICATED_RULES for the
+    bit-exact serving profile)."""
+    if rules is None:
+        rules = partition_rules(is_moe)
     return _tree_shardings(
-        param_specs(is_moe, "bq" in layers, "bo" in layers), params, mesh
+        match_partition_rules(rules, params), params, mesh
     )
 
 
@@ -170,18 +238,66 @@ def cache_shardings(mesh: Mesh, batch: int | None = None):
     )
 
 
-def shard_params(params: dict, mesh: Mesh, is_moe: bool) -> dict:
+def paged_pool_specs() -> dict:
+    """Declarative PartitionSpecs for the paged KV pool fields.
+
+    Pages [L, P, K, ps, D] shard kv heads over tp (mirroring the dense
+    cache layout — the paged kernel's shard_map contract); block tables
+    and lengths replicate at rest, and the kernel wrapper slices their
+    batch rows over dp per dispatch (ops.pallas._sharded_paged), so dp
+    replica groups each attend their own slot slice."""
+    page = P(None, None, "tp", None, None)
+    rep = P()
+    return {
+        "k_pages": page, "v_pages": page,
+        "k_scales": page, "v_scales": page,
+        "block_table": rep, "lengths": rep,
+    }
+
+
+def shard_paged_pool(pool, mesh: Mesh):
+    """device_put a PagedKVCache onto the mesh per paged_pool_specs
+    (None fields — the non-int8 pool's scales — pass through)."""
+    specs = paged_pool_specs()
+
+    def put(name, arr):
+        if arr is None:
+            return None
+        return jax.device_put(arr, NamedSharding(mesh, specs[name]))
+
+    return pool._replace(
+        **{name: put(name, getattr(pool, name)) for name in specs}
+    )
+
+
+def shard_params(
+    params: dict, mesh: Mesh, is_moe: bool, rules=None
+) -> dict:
     """device_put the pytree with TP/EP shardings. Axes that don't divide a
     dimension would error in jax; callers choose mesh sizes accordingly
     (tp | num_kv_heads etc. via mesh.best_mesh_shape)."""
-    shardings = param_shardings(params, mesh, is_moe)
+    shardings = param_shardings(params, mesh, is_moe, rules=rules)
     return jax.device_put(params, shardings)
 
 
-def shard_engine(engine, mesh: Mesh) -> None:
+def shard_engine(engine, mesh: Mesh, weights: str = "sharded") -> None:
     """Re-home an InferenceEngine onto a mesh in place: params get TP/EP
     shardings, and setting ``engine.mesh`` makes the engine's own
     ``new_cache`` produce DP/TP-sharded caches. The engine's jitted programs
-    pick the shardings up from the committed arrays."""
-    engine.params = shard_params(engine.params, mesh, engine.cfg.is_moe)
+    pick the shardings up from the committed arrays.
+
+    ``weights`` picks the rule table: "sharded" applies the Megatron
+    column/row family tables (throughput profile — NOT bit-identical to
+    single-chip, the psums reorder summation); "replicated" pins every
+    weight to REPLICATED_RULES so sharded decode stays token-identical to
+    the single-chip engine (the FEI_TPU_MESH serving default)."""
+    if weights not in ("sharded", "replicated"):
+        raise ValueError(
+            f"unknown weights profile {weights!r} "
+            "(expected 'sharded' or 'replicated')"
+        )
+    rules = REPLICATED_RULES if weights == "replicated" else None
+    engine.params = shard_params(
+        engine.params, mesh, engine.cfg.is_moe, rules=rules
+    )
     engine.mesh = mesh

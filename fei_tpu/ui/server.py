@@ -198,16 +198,19 @@ class ServeAPI:
         route, query = parts.path, parse_qs(parts.query)
         METRICS.incr("server.requests")
         if route == "/health":
+            mesh = self._mesh_tag()
             if self._draining():
                 # a draining replica must leave the load-balancer rotation
                 # while its in-flight set finishes
-                return 503, {"status": "draining",
-                             "model": self.model_name}, {"Retry-After": "5"}
+                return 503, {"status": "draining", "model": self.model_name,
+                             "mesh": mesh}, {"Retry-After": "5"}
             if self._degraded():
                 # surface the crash-loop breaker so load balancers eject
                 # the replica instead of feeding it doomed requests
-                return 503, {"status": "degraded", "model": self.model_name}
-            return 200, {"status": "ok", "model": self.model_name}
+                return 503, {"status": "degraded", "model": self.model_name,
+                             "mesh": mesh}
+            return 200, {"status": "ok", "model": self.model_name,
+                         "mesh": mesh}
         if route == "/metrics" and method == "GET":
             # pre-auth like /health: scrapers don't carry bearer tokens
             return 200, METRICS.prometheus_text()
@@ -294,6 +297,15 @@ class ServeAPI:
             "max_tokens": mt,
             **self._overrides_kw(body),
         }
+
+    def _mesh_tag(self) -> str:
+        """The backing engine's serving-mesh tag ('ms1' for single-chip
+        and for non-engine providers) — load balancers and the bench
+        ladder read capacity class off /health without a scrape."""
+        from fei_tpu.parallel.mesh import mesh_tag
+
+        eng = getattr(self.provider, "engine", None)
+        return mesh_tag(getattr(eng, "mesh", None))
 
     def _degraded(self) -> bool:
         """True when the backing engine's crash-loop breaker is holding

@@ -1,4 +1,4 @@
-"""JAX platform selection honoring ``JAX_PLATFORMS`` despite env pinning.
+"""JAX platform/version quirks kept in one place.
 
 The deployment container pins an experimental TPU platform through a
 sitecustomize hook that ignores the ``JAX_PLATFORMS`` env var; calling
@@ -6,6 +6,13 @@ sitecustomize hook that ignores the ``JAX_PLATFORMS`` env var; calling
 ``JAX_PLATFORMS=cpu python -m fei_tpu ...`` (smoke runs, outage bypass)
 actually run on CPU. One shared implementation — bench.py and the CLI
 provider path both use it, so the workaround lives in one place.
+
+``shard_map`` papers over the other environment split: newer jax ships
+``jax.shard_map(check_vma=...)`` while the CPU test image has only
+``jax.experimental.shard_map.shard_map(check_rep=...)``. Every sharded
+program in fei_tpu lifts through this wrapper so both installs run the
+same code (and the 8-device host-count CPU mesh exercises the sharded
+path in tier-1 instead of skipping it).
 """
 
 from __future__ import annotations
@@ -24,3 +31,60 @@ def honor_jax_platforms() -> None:
         import jax
 
         jax.config.update("jax_platforms", plat)
+
+
+def has_shard_map() -> bool:
+    """True when some spelling of shard_map is importable (any jax we
+    support ships at least the experimental one)."""
+    import jax
+
+    if hasattr(jax, "shard_map"):
+        return True
+    try:
+        from jax.experimental.shard_map import shard_map as _  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
+
+
+def pcast(x, axis_name, to: str = "varying"):
+    """Version-portable ``jax.lax.pcast``.
+
+    Newer jax requires replicated values to be explicitly cast to
+    device-varying before a shard_map loop writes per-device values into
+    them; the experimental shard_map has no varying-manual-axes tracking,
+    so there the cast is an identity.
+    """
+    import jax
+
+    fn = getattr(jax.lax, "pcast", None)
+    if fn is not None:
+        return fn(x, axis_name, to=to)
+    return x
+
+
+def shard_map(f, mesh=None, in_specs=None, out_specs=None,
+              check_vma: bool | None = None, **kwargs):
+    """Version-portable ``jax.shard_map``.
+
+    ``check_vma`` (the modern kwarg) maps onto the experimental API's
+    ``check_rep`` — both disable the replication/varying-manual-axes
+    checker, which cannot see through a ``pallas_call``.
+    """
+    import jax
+
+    native = getattr(jax, "shard_map", None)
+    if native is not None:
+        if check_vma is not None:
+            kwargs["check_vma"] = check_vma
+        return native(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs
+        )
+    from jax.experimental.shard_map import shard_map as legacy
+
+    if check_vma is not None:
+        kwargs["check_rep"] = check_vma
+    return legacy(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs
+    )

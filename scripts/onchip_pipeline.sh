@@ -124,6 +124,35 @@ stage preemption env FEI_TPU_TEST_PLATFORM=tpu python -m pytest \
 stage drain_restart env FEI_TPU_TEST_PLATFORM=tpu python -m pytest \
   tests/test_preemption.py::TestDrainRestart -q --timeout 600
 
+# 0e. sharded serving (FEI_TPU_MESH): the tp×dp mesh as serving mode.
+# The parity/survival proofs need a multi-chip slice, so probe the
+# attached backend's device count and size the selection to it — a tp2
+# stage on a single-chip window would fail at engine construction and
+# prove nothing. The mesh-ladder bench runs regardless: bench_sharded
+# downgrades every un-placeable rung to a loud "skipped" entry in its
+# JSON line, so a single-chip window still records the ms1 rung.
+NDEV=$(python -c 'import jax; print(len(jax.devices()))' 2>/dev/null || echo 1)
+echo "[$(date -u +%H:%M:%S)] sharded stages: $NDEV device(s) visible" \
+  >> "$OUT/pipeline.log"
+if [ "${NDEV:-1}" -ge 8 ]; then
+  stage sharded_serving env FEI_TPU_TEST_PLATFORM=tpu python -m pytest \
+    tests/test_sharded_serving.py -q --timeout 900
+elif [ "${NDEV:-1}" -ge 2 ]; then
+  # tp2 fits; the dp2-bearing cases need 4+ devices
+  stage sharded_serving env FEI_TPU_TEST_PLATFORM=tpu python -m pytest \
+    tests/test_sharded_serving.py -q --timeout 900 \
+    -k "tp2 and not tp2dp2"
+fi
+if [ "${NDEV:-1}" -ge 2 ]; then
+  # the chaos_device recovery proof, decode dispatched through the
+  # shard_map'd kernel on a real 2-chip mesh
+  stage chaos_sharded_tp2 env FEI_TPU_TEST_PLATFORM=tpu FEI_TPU_MESH=tp2 \
+    FEI_TPU_FAULT="decode.dispatch:device:1" python -m pytest \
+    tests/test_faults.py::test_env_fault_sweep_recovers -q --timeout 300
+fi
+stage bench_sharded env FEI_TPU_BENCH_SUITE=sharded \
+  FEI_TPU_BENCH_MAX_WAIT_S=300 python -u bench.py
+
 # ---- TIER 1: the gate + everything never measured on-chip (r3 stages 6b-9
 # plus the r4 additions). Run these while the window is young. ----
 

@@ -149,6 +149,23 @@ stage chaos_pool_transient -- env FEI_TPU_FAULT="pool.alloc:transient:1" \
   python -m pytest tests/test_faults.py::test_env_fault_sweep_recovers -q \
   --timeout 300
 
+# --- sharded serving (FEI_TPU_MESH): the mesh-mode bench ladder
+# (ms1 -> tp2 -> tp2dp2, each rung greedy-parity-probed against ms1; the
+# suite re-execs itself onto an 8-device host mesh), the FULL
+# parity/survival suite — slow lane included: the seeded/tp2dp2/preempt
+# proofs are too compile-heavy for tier-1's budget and run for real
+# HERE — and the chaos sweep re-armed UNDER tp2: the same recovery
+# proof as chaos_device, but with decode dispatched through the
+# shard_map'd kernel on a real mesh ----
+stage bench_sharded --json -- env FEI_TPU_BENCH_SUITE=sharded \
+  python -u bench.py
+stage sharded_serving -- python -m pytest tests/test_sharded_serving.py \
+  -q --timeout 900
+stage chaos_sharded_tp2 -- env FEI_TPU_MESH=tp2 \
+  FEI_TPU_FAULT="decode.dispatch:device:1" \
+  python -m pytest tests/test_faults.py::test_env_fault_sweep_recovers -q \
+  --timeout 300
+
 # --- KV-pressure preemption + graceful drain: byte-identical resume
 # under a deliberately tight pool, and the drain -> snapshot -> warm
 # restart replay proof (docs/ENGINE.md "Memory pressure & preemption").

@@ -27,27 +27,28 @@ import pytest  # noqa: E402
 
 
 def _jax_has_shard_map() -> bool:
-    """True when this jax exposes ``jax.shard_map`` with the ``check_vma``
-    kwarg the source tree uses. Older installs ship only
-    ``jax.experimental.shard_map.shard_map(check_rep=...)`` (accessing
-    ``jax.shard_map`` raises AttributeError), so every module built on it
-    fails at call time — an environment limitation, not a code failure."""
-    import inspect
-
+    """True when fei_tpu's version-portable shard_map wrapper resolves on
+    this jax (native ``jax.shard_map(check_vma=...)`` OR the experimental
+    ``shard_map(check_rep=...)`` it falls back to). Only a jax shipping
+    neither spelling skips the sharded suite now."""
     try:
-        return "check_vma" in inspect.signature(jax.shard_map).parameters
+        from fei_tpu.utils.platform import has_shard_map
+
+        return has_shard_map()
     except Exception:  # noqa: BLE001 — any probe failure means "absent"
         return False
 
 
 HAS_SHARD_MAP = _jax_has_shard_map()
 
-# gate for tests whose code path calls jax.shard_map(check_vma=...): they
-# skip (with the reason below) instead of polluting tier-1 with ~25
-# environment failures that read like regressions
+# gate for tests whose code path lifts through shard_map: they skip (with
+# the reason below) instead of polluting tier-1 with environment failures
+# that read like regressions. On this image the experimental fallback
+# exists, so the sharded suite runs on the forced 8-device CPU mesh.
 requires_shard_map = pytest.mark.skipif(
     not HAS_SHARD_MAP,
-    reason="installed jax lacks jax.shard_map(check_vma=...) — "
+    reason="installed jax ships no shard_map spelling "
+           "(neither jax.shard_map nor jax.experimental.shard_map) — "
            "environment limitation, not a code failure",
 )
 

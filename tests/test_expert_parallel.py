@@ -15,6 +15,7 @@ from fei_tpu.parallel.expert import (
     routed_capacity,
 )
 from fei_tpu.parallel.mesh import make_mesh
+from fei_tpu.utils.platform import shard_map
 
 
 def _setup(key, B, T, H, I, E):
@@ -188,7 +189,7 @@ class TestRoutedExpertParallel:
         n = ep_mesh.shape["ep"]
         x, router, wg, wu, wd = _setup(jax.random.PRNGKey(3), 2, 8, 32, 64, 2 * n)
         C = 2  # well below the dropless worst case of B*T/n tokens
-        fn = jax.shard_map(
+        fn = shard_map(
             functools.partial(_routed_shard, k=2, capacity=C, axis_name="ep"),
             mesh=ep_mesh,
             in_specs=(P(), P(), P("ep"), P("ep"), P("ep")),
