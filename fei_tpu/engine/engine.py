@@ -35,6 +35,8 @@ from fei_tpu.engine.sampling import sample_logits
 from fei_tpu.engine.tokenizer import load_tokenizer
 from fei_tpu.models.configs import ModelConfig, get_model_config
 from fei_tpu.models.llama import KVCache, forward, init_params
+from fei_tpu.obs.flight import FLIGHT, CompileObserver
+from fei_tpu.parallel.mesh import mesh_tag
 from fei_tpu.utils.errors import EngineError
 from fei_tpu.utils.logging import get_logger
 from fei_tpu.utils.metrics import METRICS
@@ -152,6 +154,10 @@ class InferenceEngine:
         self._prefill_cache: dict[tuple, Callable] = {}
         self._step_cache: dict[tuple, Callable] = {}
         self._fused_cache: dict[tuple, Callable] = {}
+        # per-engine jit-compile observer: every jitted-program cache miss
+        # (engine AND scheduler) registers here, so compiles/recompiles
+        # attribute to program signatures (obs/flight.py)
+        self._compiles = CompileObserver()
         # prompts at least this long prefill SEQUENCE-SHARDED over the
         # mesh's sp axis (ring attention full-model, parallel.long_prefill)
         # instead of serially — the agent loop's unbounded conversations
@@ -287,7 +293,9 @@ class InferenceEngine:
                     kernel_mesh=kernel_mesh,
                 )
 
-            self._prefill_cache[key] = jax.jit(prefill, donate_argnums=(2,))
+            self._prefill_cache[key] = self._compiles.wrap(
+                "engine.prefill", key, jax.jit(prefill, donate_argnums=(2,))
+            )
         return self._prefill_cache[key]
 
     def _step_fn(self, gen: GenerationConfig) -> Callable:
@@ -320,7 +328,9 @@ class InferenceEngine:
                 )
                 return next_token, cache, rng
 
-            self._step_cache[key] = jax.jit(step, donate_argnums=(1,))
+            self._step_cache[key] = self._compiles.wrap(
+                "engine.step", key, jax.jit(step, donate_argnums=(1,))
+            )
         return self._step_cache[key]
 
     def _grammar_fused_fn(
@@ -377,7 +387,9 @@ class InferenceEngine:
                 )
                 return jnp.swapaxes(toks, 0, 1), cache, token, rng, gstate, remaining
 
-            self._fused_cache[key] = jax.jit(fused, donate_argnums=(1,))
+            self._fused_cache[key] = self._compiles.wrap(
+                "engine.fused", key, jax.jit(fused, donate_argnums=(1,))
+            )
         return self._fused_cache[key]
 
     def generate_constrained(
@@ -491,8 +503,9 @@ class InferenceEngine:
                 forward, routed_moe=self.mesh is None,
                 moe_mesh=self._moe_mesh(), kernel_mesh=self.mesh,
             )
-            self._fused_cache[key] = build_fused_decode(
-                fwd, self.cfg, gen, n_steps
+            self._fused_cache[key] = self._compiles.wrap(
+                "engine.fused", key,
+                build_fused_decode(fwd, self.cfg, gen, n_steps),
             )
         return self._fused_cache[key]
 
@@ -614,9 +627,15 @@ class InferenceEngine:
     def _prefill_sample(self, prompt_ids, gen: GenerationConfig, mask=None):
         """Shared generation prologue: prefill, optional first-token logit
         mask, sample. Returns (tok [B], cache, rng)."""
+        t0 = time.perf_counter()
         with METRICS.span("prefill", jax_trace=True):
             last_logits, cache = self.prefill([list(prompt_ids)], self.new_cache(1))
+            t_issue = time.perf_counter()
             last_logits.block_until_ready()
+        FLIGHT.dispatch(
+            "dispatch.prefill", t0, t_issue, time.perf_counter(),
+            mesh=mesh_tag(self.mesh), tokens=len(prompt_ids),
+        )
         if mask is not None:
             last_logits = jnp.where(mask[None, :], last_logits, -jnp.inf)
         rng = jax.random.PRNGKey(gen.seed)
@@ -709,7 +728,10 @@ class InferenceEngine:
                 )
                 return logits, cache._replace(k=k, v=v, length=true_len)
 
-            self._sp_prefill_jit = jax.jit(sp_prefill, donate_argnums=(3,))
+            self._sp_prefill_jit = self._compiles.wrap(
+                "engine.sp_prefill", "sp",
+                jax.jit(sp_prefill, donate_argnums=(3,)),
+            )
         return self._sp_prefill_jit
 
     def prefill(self, prompt_ids: Sequence[Sequence[int]], cache: KVCache):
@@ -796,12 +818,21 @@ class InferenceEngine:
                 break  # cache full: don't run a step whose KV slot doesn't exist
             mask = self._pad_mask(logit_mask_fn(generated)) if logit_mask_fn else None
             mask_dev = None if mask is None else mask[None, :]
+            t0 = time.perf_counter()
             with METRICS.span("decode_step"):
                 METRICS.incr("engine.decode_dispatches")
                 tok, cache, rng = step(
                     self.params, cache, tok.reshape(1, 1), rng, mask_dev
                 )
+                t_issue = time.perf_counter()
                 tok_host = int(tok[0])  # host sync inside the span
+            t1 = time.perf_counter()
+            METRICS.timing("dispatch_issue", t_issue - t0)
+            METRICS.timing("dispatch_sync", t1 - t_issue)
+            FLIGHT.dispatch(
+                "dispatch.decode", t0, t_issue, t1,
+                mesh=mesh_tag(self.mesh), n_steps=1, slots=1,
+            )
 
     def _stream_chunked(
         self, prompt_ids: Sequence[int], gen: GenerationConfig, chunk: int
@@ -948,12 +979,21 @@ class InferenceEngine:
                     METRICS.incr("engine.grammar_trigger_suffix_rejected")
                 if i >= budget:
                     return
+                t0 = time.perf_counter()
                 with METRICS.span("decode_step"):
                     METRICS.incr("engine.decode_dispatches")
                     tok, cache, rng = step(
                         self.params, cache, tok.reshape(1, 1), rng, None
                     )
+                    t_issue = time.perf_counter()
                     tok_host = int(tok[0])
+                t1 = time.perf_counter()
+                METRICS.timing("dispatch_issue", t_issue - t0)
+                METRICS.timing("dispatch_sync", t1 - t_issue)
+                FLIGHT.dispatch(
+                    "dispatch.decode", t0, t_issue, t1,
+                    mesh=mesh_tag(self.mesh), n_steps=1, slots=1,
+                )
             token = tok.reshape(1, 1)
         if gstate < 0 or i >= budget:
             return

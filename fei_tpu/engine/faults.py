@@ -41,6 +41,7 @@ import os
 import threading
 from typing import Callable
 
+from fei_tpu.obs.flight import FLIGHT
 from fei_tpu.utils.errors import (
     DeviceError,
     EngineError,
@@ -164,6 +165,7 @@ class FaultInjector:
             self._fired[point] = self._fired.get(point, 0) + 1
             kind = fault.kind
         log.warning("firing injected %s fault at %s", kind, point)
+        FLIGHT.event("fault", point=point, kind=kind, rid=ctx.get("rid"))
         raise _make_exc(kind, point)
 
     def fired(self, point: str) -> int:

@@ -32,6 +32,7 @@ scan) and ``generate_fused``. The per-token loop survives behind
 from __future__ import annotations
 
 import os
+import time
 from dataclasses import dataclass
 from typing import Callable, Iterator
 
@@ -40,6 +41,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from fei_tpu.engine.sampling import sample_logits, stop_mask
+from fei_tpu.obs import costmodel
+from fei_tpu.obs.flight import FLIGHT
+from fei_tpu.parallel.mesh import mesh_tag
 from fei_tpu.utils.metrics import METRICS
 
 DEFAULT_CHUNK = 16
@@ -175,24 +179,39 @@ class ChunkDecoder:
                 n = self._chunk if self._slots_left >= self._chunk else self._slots_left
                 fused = self._engine._free_fused_fn(self._gen, n)
                 METRICS.incr("engine.decode_dispatches")
+                t0 = time.perf_counter()
                 toks, self._cache, self._token, self._rng, self._done, rngs = fused(
                     self._engine.params, self._cache, self._token, self._rng,
                     self._done, self._stop_ids,
+                )
+                t_issue = time.perf_counter()
+                METRICS.timing("dispatch_issue", t_issue - t0)
+                # sync is pipelined: chunk k blocks in NEXT iteration's
+                # decode_chunk span, so this record carries zero sync time
+                FLIGHT.dispatch(
+                    "dispatch.decode", t0, t_issue, t_issue,
+                    mesh=mesh_tag(self._engine.mesh), n_steps=n,
+                    slots=int(self._token.shape[0]), pipelined=True,
                 )
                 fed0 = self._fed
                 self._fed += n
                 self._slots_left -= n
                 self._sched += n
-                nxt = (toks, rngs, fed0)
+                nxt = (toks, rngs, fed0, t0, n)
             if pending is None:
                 if nxt is None:
                     return
             else:
-                toks_p, rngs_p, fed0_p = pending
+                toks_p, rngs_p, fed0_p, t0_p, n_p = pending
                 with METRICS.span("decode_chunk"):
                     # ONE host transfer per chunk; this is the only
                     # blocking point — chunk k+1 is already in flight
                     host = np.asarray(toks_p)[0].tolist()
+                slots = int(self._token.shape[0])
+                costmodel.account_dispatch(
+                    self._engine, n_p, fed0_p * slots, slots,
+                    time.perf_counter() - t0_p,
+                )
                 yield DecodedChunk(tokens=host, rngs=rngs_p, fed0=fed0_p)
             pending = nxt
 

@@ -28,6 +28,8 @@ from fei_tpu.utils.errors import (
     EngineError,
     PoolPressure,
 )
+from fei_tpu.obs.flight import FLIGHT
+from fei_tpu.parallel.mesh import mesh_tag
 from fei_tpu.utils.logging import get_logger
 from fei_tpu.utils.metrics import METRICS
 
@@ -139,6 +141,10 @@ class AdmissionMixin:
                 seq.trace.event("admitted")
             METRICS.observe(
                 "queue_wait_seconds", time.perf_counter() - seq.t_queued
+            )
+            FLIGHT.event(
+                "admit", rid=seq.rid, slot=slot, lazy=seq.lazy,
+                prefix_pages=len(prefix),
             )
             self._update_sched_gauges()
             try:
@@ -257,13 +263,19 @@ class AdmissionMixin:
                 "at admission"
             )
 
+        t0 = time.perf_counter()
         with METRICS.span("prefill", jax_trace=True):
             from fei_tpu.engine.engine import _next_bucket
 
             bucket = min(_next_bucket(n), eng.max_seq_len)
             dense = KVCache.create(cfg, 1, bucket, dtype=eng.dtype)
             last_logits, dense = eng.prefill([ids], dense)
+            t_issue = time.perf_counter()
             last_logits.block_until_ready()
+        FLIGHT.dispatch(
+            "dispatch.prefill", t0, t_issue, time.perf_counter(),
+            rid=seq.rid, mesh=mesh_tag(eng.mesh), slot=slot, tokens=n,
+        )
 
         self._complete_admission(seq, slot, dense, bucket, last_logits)
 
@@ -406,12 +418,20 @@ class AdmissionMixin:
                     hi = min(lo + R, n)
                     rt = np.zeros((R,), dtype=np.int32)
                     rt[: hi - lo] = prompt[lo:hi]
+                    t0 = time.perf_counter()
                     with METRICS.span("prefill_chunk", jax_trace=True):
                         self._pool = self._replay_fn(R)(
                             eng.params, self._pool, jnp.asarray(rt),
                             jnp.asarray(st["row"]), jnp.int32(st["slot"]),
                             jnp.asarray(lo, dtype=jnp.int32),
                         )
+                    # no host sync: the replayed pool stays on device
+                    t_issue = time.perf_counter()
+                    FLIGHT.dispatch(
+                        "dispatch.prefill_chunk", t0, t_issue, t_issue,
+                        rid=seq.rid, mesh=mesh_tag(eng.mesh),
+                        slot=st["slot"], tokens=hi - lo, replay=True,
+                    )
                     METRICS.incr(
                         "scheduler.resume_replayed_tokens", hi - lo
                     )
@@ -435,6 +455,7 @@ class AdmissionMixin:
             final = hi >= n_pre
         if st.get("mode") == "paged":
             try:
+                t0 = time.perf_counter()
                 with METRICS.span("prefill_chunk", jax_trace=True):
                     fn = self._paged_chunk_fn(C, final)
                     out = fn(
@@ -443,11 +464,18 @@ class AdmissionMixin:
                         jnp.asarray([lo], dtype=jnp.int32),
                         jnp.int32(n - 1 - lo),
                     )
+                    t_issue = time.perf_counter()
                     if final:
                         last_logits, self._pool = out
                         last_logits.block_until_ready()
                     else:
                         self._pool = out
+                FLIGHT.dispatch(
+                    "dispatch.prefill_chunk", t0, t_issue,
+                    time.perf_counter(), rid=seq.rid,
+                    mesh=mesh_tag(eng.mesh), slot=st["slot"],
+                    tokens=hi - lo, paged=True,
+                )
             except Exception as exc:  # noqa: BLE001
                 first = lo == st["prefix"] * eng.page_size
                 if first and self._pool_intact():
@@ -484,12 +512,19 @@ class AdmissionMixin:
                 prefix_pages=st.get("prefix", 0),
             )
             return
+        t0 = time.perf_counter()
         with METRICS.span("prefill_chunk", jax_trace=True):
             fn = self._chunk_fn(C, st["bucket"])
             last_logits, st["dense"] = fn(
                 eng.params, st["dense"], jnp.asarray(toks), jnp.int32(hi - lo)
             )
+            t_issue = time.perf_counter()
             last_logits.block_until_ready()
+        FLIGHT.dispatch(
+            "dispatch.prefill_chunk", t0, t_issue, time.perf_counter(),
+            rid=seq.rid, mesh=mesh_tag(eng.mesh), slot=st["slot"],
+            tokens=hi - lo,
+        )
         st["pos"] = hi
         if hi < n:
             return  # more chunks; decode steps interleave
@@ -534,7 +569,9 @@ class AdmissionMixin:
                 )  # [1, 1, H] — already final-normed (lm_head=False contract)
                 return _logits(h_last, params, cfg, kernel_mesh=mesh)[:, 0], out_pool
 
-            self._pchunk_jit[key] = jax.jit(chunk, donate_argnums=(1,))
+            self._pchunk_jit[key] = self.engine._compiles.wrap(
+                "sched.paged_chunk", key, jax.jit(chunk, donate_argnums=(1,))
+            )
         return self._pchunk_jit[key]
 
 
@@ -577,7 +614,9 @@ class AdmissionMixin:
                 view, _ = jax.lax.scan(body, view, toks)
                 return view._replace(block_table=bt0, lengths=ln0)
 
-            self._replay_jit[R] = jax.jit(replay, donate_argnums=(1,))
+            self._replay_jit[R] = self.engine._compiles.wrap(
+                "sched.replay", R, jax.jit(replay, donate_argnums=(1,))
+            )
         return self._replay_jit[R]
 
 
@@ -595,7 +634,9 @@ class AdmissionMixin:
                 )
                 return pool._replace(block_table=bt, lengths=ln)
 
-            self._arm_jit = jax.jit(arm, donate_argnums=(0,))
+            self._arm_jit = self.engine._compiles.wrap(
+                "sched.arm", 0, jax.jit(arm, donate_argnums=(0,))
+            )
         return self._arm_jit
 
 
@@ -650,6 +691,10 @@ class AdmissionMixin:
         seq.next_input = seq.generated[-1]
         if seq.trace is not None:
             seq.trace.event("resumed")
+        FLIGHT.event(
+            "resume", rid=seq.rid, slot=seq.slot,
+            generated=len(seq.generated), prefix_pages=prefix_pages,
+        )
         METRICS.incr(
             "scheduler.preempted_tokens_recomputed",
             max(0, n - prefix_pages * alloc.page_size),
@@ -698,7 +743,9 @@ class AdmissionMixin:
                     k=k, v=v, length=true_tokens[None].astype(jnp.int32),
                 )
 
-            self._gather_jit[key] = jax.jit(gather, donate_argnums=(2,))
+            self._gather_jit[key] = self.engine._compiles.wrap(
+                "sched.gather", key, jax.jit(gather, donate_argnums=(2,))
+            )
         return self._gather_jit[key]
 
 
@@ -731,7 +778,9 @@ class AdmissionMixin:
                     :, 0
                 ], cache2
 
-            self._chunk_jit[key] = jax.jit(chunk, donate_argnums=(1,))
+            self._chunk_jit[key] = self.engine._compiles.wrap(
+                "sched.chunk", key, jax.jit(chunk, donate_argnums=(1,))
+            )
         return self._chunk_jit[key]
 
 
@@ -879,6 +928,8 @@ class AdmissionMixin:
 
             # only the pool is donated: the dense prefill K/V are reshaped
             # (layout change), so XLA could not reuse their buffers anyway
-            self._admit_jit[key] = jax.jit(admit, donate_argnums=(0,))
+            self._admit_jit[key] = self.engine._compiles.wrap(
+                "sched.admit", key, jax.jit(admit, donate_argnums=(0,))
+            )
         return self._admit_jit[key]
 

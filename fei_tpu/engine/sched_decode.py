@@ -19,6 +19,9 @@ import numpy as np
 from fei_tpu.engine.faults import FAULTS
 from fei_tpu.engine.sampling import sample_logits_dynamic
 from fei_tpu.models.llama import forward_paged
+from fei_tpu.obs import costmodel
+from fei_tpu.obs.flight import FLIGHT
+from fei_tpu.parallel.mesh import mesh_tag
 from fei_tpu.utils.logging import get_logger
 from fei_tpu.utils.metrics import METRICS
 
@@ -81,11 +84,17 @@ class DecodeMixin:
         tokens = np.zeros((self.B, T), dtype=np.int32)
         tokens[b] = [s.next_input] + draft
         try:
+            t0 = time.perf_counter()
             with METRICS.span("spec_step"):
                 greedy_dev, self._pool = self._spec_fn(T)(
                     eng.params, self._pool, jnp.asarray(tokens)
                 )
+                t_issue = time.perf_counter()
                 greedy = np.asarray(greedy_dev)[b]  # host sync in the span
+            FLIGHT.dispatch(
+                "dispatch.spec", t0, t_issue, time.perf_counter(),
+                rid=s.rid, mesh=mesh_tag(eng.mesh), slot=b, draft=T - 1,
+            )
         except Exception as exc:  # noqa: BLE001
             if self._pool_intact():
                 # compile-stage failure (e.g. Mosaic rejecting the block
@@ -148,7 +157,9 @@ class DecodeMixin:
                 )
                 return jnp.argmax(logits, axis=-1).astype(jnp.int32), pool
 
-            self._step_jit[key] = jax.jit(spec, donate_argnums=(1,))
+            self._step_jit[key] = self.engine._compiles.wrap(
+                "sched.spec", key, jax.jit(spec, donate_argnums=(1,))
+            )
         return self._step_jit[key]
 
 
@@ -263,7 +274,10 @@ class DecodeMixin:
         if n <= 1:
             return False
         under_admission = bool(self._waiting) or self._admitting is not None
-
+        FLIGHT.event(
+            "turbo_arm", depth=n, slots=len(active),
+            under_admission=under_admission,
+        )
         toks = self._dispatch_steps(active, n)
         METRICS.incr("scheduler.multi_steps")
         METRICS.incr("scheduler.multi_tokens", n)
@@ -316,10 +330,15 @@ class DecodeMixin:
         self._pool = replace_lengths(self._pool, lengths)
         for b, i in rollback.items():
             self._keys = self._keys.at[b].set(self._step_keys[i, b])
+        discarded = sum(n - 1 - i for i in rollback.values())
         METRICS.incr("scheduler.turbo_rollbacks", len(rollback))
-        METRICS.incr(
-            "scheduler.turbo_rollback_tokens",
-            sum(n - 1 - i for i in rollback.values()),
+        METRICS.incr("scheduler.turbo_rollback_tokens", discarded)
+        FLIGHT.event(
+            "rollback", slots=sorted(rollback), tokens=discarded,
+            rids=[
+                self._slots[b].rid for b in rollback
+                if self._slots[b] is not None
+            ],
         )
 
 
@@ -419,8 +438,22 @@ class DecodeMixin:
         t0 = time.perf_counter()
         with METRICS.span("decode_step"):
             nxt, self._step_keys, self._pool, self._keys = step(*args, **kw)
+            t_issue = time.perf_counter()
             out = np.asarray(nxt)  # host sync inside the span
-        self._record_collective_time(time.perf_counter() - t0)
+        t1 = time.perf_counter()
+        self._record_collective_time(t1 - t0)
+        METRICS.timing("dispatch_issue", t_issue - t0)
+        METRICS.timing("dispatch_sync", t1 - t_issue)
+        FLIGHT.dispatch(
+            "dispatch.step", t0, t_issue, t1,
+            rids=[s.rid for _, s in active], mesh=mesh_tag(eng.mesh),
+            n_steps=n, slots=len(active),
+        )
+        costmodel.account_dispatch(
+            eng, n,
+            sum(len(s.prompt_ids) + len(s.generated) for _, s in active),
+            len(active), t1 - t0,
+        )
         for _, s in active:
             s.shield = False  # survived a dispatch: victimizable again
         return out
@@ -506,6 +539,8 @@ class DecodeMixin:
                 # rollback) with bit-identical seeded sampling
                 return jnp.swapaxes(toks, 0, 1), step_keys, carry[0], carry[2]
 
-            self._step_jit[key] = jax.jit(multi, donate_argnums=(1,))
+            self._step_jit[key] = self.engine._compiles.wrap(
+                "sched.multi", key, jax.jit(multi, donate_argnums=(1,))
+            )
         return self._step_jit[key]
 

@@ -50,6 +50,7 @@ from fei_tpu.engine.faults import FAULTS
 from fei_tpu.engine.sched_admission import AdmissionMixin
 from fei_tpu.engine.sched_constrain import ConstraintMixin
 from fei_tpu.engine.sched_decode import DecodeMixin
+from fei_tpu.obs.flight import FLIGHT
 from fei_tpu.obs.trace import TRACES
 from fei_tpu.utils.errors import (
     DeadlineExceededError,
@@ -814,6 +815,10 @@ class PagedScheduler(AdmissionMixin, DecodeMixin, ConstraintMixin):
         if len(self._fail_times) >= self.breaker_fails:
             self._degraded_until = now + self.breaker_cooldown_s
             METRICS.gauge("engine.degraded", 1)
+            FLIGHT.event(
+                "breaker_trip", fails=len(self._fail_times),
+                cooldown_s=self.breaker_cooldown_s,
+            )
             log.error(
                 "crash-loop breaker tripped: %d device failures within "
                 "%.0fs; rejecting submits for %.0fs",
@@ -897,6 +902,10 @@ class PagedScheduler(AdmissionMixin, DecodeMixin, ConstraintMixin):
         if seq.trace is not None:
             seq.trace.event("preempted")
         METRICS.incr("scheduler.preemptions")
+        FLIGHT.event(
+            "preempt", rid=seq.rid, slot=slot,
+            generated=len(seq.generated), requeue=requeue,
+        )
         log.info(
             "preempted %s (%d/%d tokens) under pool pressure",
             seq.rid, len(seq.generated), seq.budget,
@@ -987,6 +996,11 @@ class PagedScheduler(AdmissionMixin, DecodeMixin, ConstraintMixin):
             if busy and not thread_alive:
                 self._start_thread()
         METRICS.gauge("engine.draining", 1)
+        FLIGHT.event(
+            "drain", deadline_s=round(
+                self._drain_deadline - time.monotonic(), 3
+            ),
+        )
         log.info(
             "drain started (deadline %.1fs, snapshot dir %s)",
             self._drain_deadline - time.monotonic(), self._drain_dir or "-",
@@ -1066,6 +1080,9 @@ class PagedScheduler(AdmissionMixin, DecodeMixin, ConstraintMixin):
                 self._trace_finish(s, "failed")
             else:
                 snaps.append(snap)
+                FLIGHT.event(
+                    "snapshot", rid=s.rid, generated=len(s.generated),
+                )
                 s.out.put(EngineDrainingError(
                     "engine drained before this request completed; it was "
                     "snapshotted for warm restart",

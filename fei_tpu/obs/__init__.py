@@ -1,7 +1,9 @@
-"""Observability subsystem: metrics, histograms, request traces, and
-Prometheus exposition. fei_tpu/utils/metrics.py re-exports the METRICS
-singleton from here so pre-existing call sites are unchanged."""
+"""Observability subsystem: metrics, histograms, request traces, the
+engine flight recorder, the roofline cost model, and Prometheus
+exposition. fei_tpu/utils/metrics.py re-exports the METRICS singleton
+from here so pre-existing call sites are unchanged."""
 
+from fei_tpu.obs.flight import FLIGHT, CompileObserver, FlightRecorder
 from fei_tpu.obs.metrics import (
     DEFAULT_BUCKETS,
     METRICS,
@@ -14,8 +16,11 @@ from fei_tpu.obs.trace import TRACES, RequestTrace, TraceBuffer
 
 __all__ = [
     "DEFAULT_BUCKETS",
+    "FLIGHT",
     "METRICS",
     "METRIC_REGISTRY",
+    "CompileObserver",
+    "FlightRecorder",
     "Histogram",
     "Metrics",
     "RequestTrace",

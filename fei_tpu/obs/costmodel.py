@@ -1,0 +1,168 @@
+"""Analytical roofline accountant: per-dispatch HBM-bytes / FLOPs
+estimates from the model config + batch/page geometry.
+
+Single-stream decode is weight-streaming-bound, so achieved tok/s ×
+bytes-streamed-per-token against the chip's HBM bandwidth — not MFU — is
+the lens that says whether there is headroom (BASELINE.md measures the
+same ceiling empirically). This module owns the byte model bench.py
+reports against, plus live per-dispatch accounting the scheduler feeds
+into the ``roofline.frac`` / ``roofline.tok_s_per_chip`` gauges so
+``/metrics`` and every bench line carry the fraction-of-roofline a run
+actually achieved.
+
+``FEI_TPU_HBM_GBPS`` overrides the per-chip bandwidth ceiling (default
+the v5e spec number) — e.g. when serving on a different TPU generation.
+"""
+
+from __future__ import annotations
+
+import os
+
+# v5e HBM bandwidth (chip spec ~819 GB/s) — the default roofline ceiling
+V5E_HBM_GBPS = 819.0
+
+
+def hbm_gbps() -> float:
+    """The per-chip HBM bandwidth ceiling (GB/s) the roofline fraction is
+    computed against; ``FEI_TPU_HBM_GBPS`` overrides the v5e default."""
+    try:
+        return float(os.environ.get("FEI_TPU_HBM_GBPS", "") or V5E_HBM_GBPS)
+    except ValueError:
+        return V5E_HBM_GBPS
+
+
+def decode_stream_bytes(engine, mean_ctx: int) -> dict:
+    """HBM bytes streamed to decode ONE token (the roofline basis,
+    round-4 verdict #5): every weight byte except the embedding table
+    (a gather reads ~one row; tied embeddings ARE the lm_head and stream
+    fully), MoE expert bytes scaled to the top-k actually routed, plus the
+    K/V cache read at the mean decode context and the new token's K/V
+    write. Activations/norm traffic is O(hidden) per layer — noise next to
+    the weight stream — and is reported inside `other` by omission."""
+    from fei_tpu.ops.quant import param_bytes
+
+    cfg = engine.cfg
+    p = engine.params
+    weights = param_bytes(p)
+    if not cfg.tie_embeddings and "embed" in p:
+        weights -= param_bytes(p["embed"])
+    if cfg.is_moe:
+        k, E = cfg.num_experts_per_tok, cfg.num_experts
+        layers = p.get("layers", {})
+        for name in ("w_gate", "w_up", "w_down"):
+            if name in layers:
+                weights -= param_bytes(layers[name]) * (1 - k / E)
+    kv_row = kv_row_bytes(engine)
+    kv_read = kv_row * mean_ctx
+    kv_write = kv_row
+    return {
+        "weights": int(weights),
+        "kv_read": int(kv_read),
+        "kv_write": int(kv_write),
+        "total": int(weights + kv_read + kv_write),
+    }
+
+
+def kv_row_bytes(engine) -> int:
+    """Bytes of K+V cache per token position (all layers)."""
+    import jax.numpy as jnp
+
+    cfg = engine.cfg
+    itemsize = jnp.dtype(engine.dtype).itemsize
+    return 2 * cfg.num_layers * cfg.num_kv_heads * cfg.head_dim_ * itemsize
+
+
+def _element_count(tree) -> int:
+    import jax
+    import numpy as np
+
+    return sum(
+        int(np.prod(leaf.shape))
+        for leaf in jax.tree_util.tree_leaves(tree)
+        if hasattr(leaf, "shape")
+    )
+
+
+def decode_flops_per_token(engine) -> int:
+    """FLOPs to decode one token ≈ 2 × parameters touched (the matmul
+    2·m·n·k identity at batch 1): the embedding gather reads one row so
+    an untied table is excluded, and MoE expert weights scale to the
+    routed top-k — the same active-weight model as the byte estimate."""
+    cfg = engine.cfg
+    p = engine.params
+    n = _element_count(p)
+    if not cfg.tie_embeddings and "embed" in p:
+        n -= _element_count(p["embed"])
+    if cfg.is_moe:
+        k, E = cfg.num_experts_per_tok, cfg.num_experts
+        layers = p.get("layers", {})
+        for name in ("w_gate", "w_up", "w_down"):
+            if name in layers:
+                n -= _element_count(layers[name]) * (1 - k / E)
+    return 2 * int(n)
+
+
+def dispatch_bytes(engine, n_steps: int, total_ctx: int, slots: int) -> int:
+    """HBM bytes one batched decode dispatch streams: per scanned step the
+    full weight stream plus a K/V read over every active slot's context
+    and one K/V row write per slot. ``total_ctx`` is the summed context
+    length across active slots at dispatch time (the scan's mid-point
+    growth is noise at this resolution)."""
+    sb = decode_stream_bytes(engine, 0)
+    kv_row = kv_row_bytes(engine)
+    per_step = sb["weights"] + kv_row * (total_ctx + slots)
+    return int(max(1, n_steps) * per_step)
+
+
+def roofline_fraction(bytes_streamed: int, dt_s: float,
+                      n_chips: int = 1) -> float:
+    """Fraction of the aggregate HBM roofline achieved: estimated bytes
+    over wall time vs ``n_chips`` × the per-chip ceiling."""
+    if dt_s <= 0:
+        return 0.0
+    gbps = bytes_streamed / dt_s / 1e9
+    return gbps / (hbm_gbps() * max(1, n_chips))
+
+
+def chips_for_tag(tag: str | None) -> int:
+    """Device count implied by a serving-mesh tag (``ms1`` → 1,
+    ``tp2dp2`` → 4). Unparseable tags count as one chip — a wrong
+    denominator must never sink a bench line."""
+    if not tag or tag in ("ms1", "off"):
+        return 1
+    try:
+        from fei_tpu.parallel.mesh import parse_mesh_shape
+
+        sizes = parse_mesh_shape(tag)
+        n = 1
+        for s in dict(sizes).values():
+            n *= int(s)
+        return max(1, n)
+    except Exception:  # noqa: BLE001
+        return 1
+
+
+def account_dispatch(engine, n_steps: int, total_ctx: int, slots: int,
+                     dt_s: float) -> None:
+    """Live roofline accounting for one decode dispatch: update the
+    ``roofline.frac`` and ``roofline.tok_s_per_chip`` gauges from the
+    analytical byte estimate and the measured wall time."""
+    from fei_tpu.obs.metrics import METRICS
+    from fei_tpu.parallel.mesh import AXES, axis_size
+
+    if dt_s <= 0:
+        return
+    mesh = getattr(engine, "mesh", None)
+    n_chips = 1
+    for ax in AXES:
+        n_chips *= axis_size(mesh, ax)
+    est = dispatch_bytes(engine, n_steps, total_ctx, slots)
+    # 9 decimals: a tiny CPU model's frac is O(1e-7) and must not round
+    # to a flat zero; production fractions are O(0.1) and unaffected
+    METRICS.gauge(
+        "roofline.frac", round(roofline_fraction(est, dt_s, n_chips), 9)
+    )
+    METRICS.gauge(
+        "roofline.tok_s_per_chip",
+        round(n_steps * slots / dt_s / max(1, n_chips), 3),
+    )

@@ -116,6 +116,14 @@ METRIC_REGISTRY: dict[str, tuple[str, str]] = {
                                 "On-demand jax.profiler captures taken."),
     "server.drains": ("counter", "Graceful drains initiated via POST "
                                  "/drain."),
+    "engine.compiles": ("counter",
+                        "Jit program compilations observed (first build "
+                        "per program signature — warmup cost)."),
+    "engine.recompiles": ("counter",
+                          "Compilations of an ALREADY-SEEN program "
+                          "signature: steady-state recompiles; each one "
+                          "is a dropped cache or a shape leak, not "
+                          "warmup."),
     # --- gauges ---------------------------------------------------------
     "last_ttft_s": ("gauge", "TTFT of the most recent generation (s)."),
     "last_decode_tok_s": ("gauge",
@@ -149,11 +157,28 @@ METRIC_REGISTRY: dict[str, tuple[str, str]] = {
     "pool.pages_free": ("gauge", "Free KV pages."),
     "pool.pages_in_use": ("gauge", "KV pages currently referenced."),
     "prefix.entries": ("gauge", "Entries resident in the prefix cache."),
+    "roofline.frac": ("gauge",
+                      "Fraction of the aggregate HBM roofline achieved by "
+                      "the most recent decode dispatch (analytical bytes "
+                      "estimate / wall time vs FEI_TPU_HBM_GBPS × chips)."),
+    "roofline.tok_s_per_chip": ("gauge",
+                                "Delivered tokens/s per chip over the most "
+                                "recent decode dispatch."),
     # --- spans (each also feeds a <name>_seconds histogram) -------------
     "prefill": ("span", "Full prefill dispatch."),
     "prefill_chunk": ("span", "One chunked-prefill chunk."),
     "prefill_sp": ("span", "Sequence-parallel prefill dispatch."),
     "decode_step": ("span", "One device decode step."),
+    "dispatch_issue": ("span",
+                       "Host time to ISSUE one decode dispatch (call "
+                       "until the jitted function returned; the device "
+                       "keeps running)."),
+    "dispatch_sync": ("span",
+                      "Host block-until-ready time for one decode "
+                      "dispatch (device compute + transport)."),
+    "compile": ("span",
+                "One observed jit compilation (first invocation of a "
+                "program signature)."),
     "decode_chunk": ("span", "One fused free-phase decode chunk (the "
                              "blocking host sync; dispatch is pipelined)."),
     "spec_step": ("span", "One speculative decode step."),

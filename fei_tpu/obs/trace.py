@@ -109,6 +109,15 @@ class TraceBuffer:
             except OSError:
                 pass  # tracing must never take down the serving loop
 
+    def get(self, rid: str) -> RequestTrace | None:
+        """The trace with request id ``rid``, or None if it was never
+        recorded or has been evicted from the ring."""
+        with self._lock:
+            for tr in reversed(self._ring):
+                if tr.rid == rid:
+                    return tr
+        return None
+
     def recent(self, limit: int = 50) -> list[dict]:
         """Most recent traces first (active ones included)."""
         with self._lock:

@@ -26,6 +26,7 @@ import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlsplit
 
+from fei_tpu.obs.flight import FLIGHT
 from fei_tpu.obs.trace import TRACES
 from fei_tpu.utils.errors import (
     DeadlineExceededError,
@@ -231,6 +232,21 @@ class ServeAPI:
                                        "type": "invalid_request_error"}}
             limit = min(max(limit, 1), 1000)
             return 200, {"object": "list", "data": TRACES.recent(limit)}
+        if route.startswith("/v1/traces/") and method == "GET":
+            rid = route.rsplit("/", 1)[1]
+            tr = TRACES.get(rid)
+            if tr is None:
+                return 404, {"error": {
+                    "message": f"no trace {rid!r} (unknown or evicted)",
+                    "type": "invalid_request_error"}}
+            payload = tr.as_dict()
+            # the request's slice of the engine flight recorder: every
+            # dispatch and scheduler event tagged with this rid
+            payload["flight"] = FLIGHT.for_rid(rid)
+            return 200, payload
+        if route == "/debug/timeline" and method == "GET":
+            # Chrome-trace / Perfetto JSON of the engine flight recorder
+            return 200, FLIGHT.chrome_trace()
         if route == "/v1/chat/completions" and method == "POST":
             return self._chat(body)
         if route == "/drain" and method == "POST":

@@ -1,0 +1,47 @@
+# Bounded TPU attach probe with a labeled diagnosis — source this file.
+#
+# attach_probe [timeout_s] runs `jax.devices()` in a DETACHED subprocess
+# that writes a marker file on success, and polls the marker up to the
+# timeout (default $ATTACH_TIMEOUT or 300 s). The probe is NEVER killed:
+# a client killed mid-claim wedges the chip lease and every subsequent
+# attach hangs until the lease expires (round-2 outage) — on timeout it
+# is abandoned to finish on its own schedule and release any claim.
+#
+# Always exports FEI_TPU_ATTACH_DIAG with one of three labeled verdicts —
+# bench.py copies it into every emitted JSON line as "attach_diag":
+#   attach-ok:<backend>:<n> in <t>s      — backend attached
+#   attach-failed:<reason>               — probe exited nonzero (backend
+#                                          down / unreachable: fails FAST)
+#   attach-hung:<detail>                 — probe still blocked in attach
+#                                          at the timeout (wedged lease:
+#                                          fails SLOW) — probe abandoned
+# Return code: 0 ok, 1 failed, 2 hung.
+
+attach_probe() {
+  local timeout_s="${1:-${ATTACH_TIMEOUT:-300}}"
+  local marker pid t0
+  marker=$(mktemp /tmp/attach_probe.XXXXXX.marker)
+  rm -f "$marker"
+  setsid python -c "
+import jax
+ds = jax.devices()
+with open('$marker', 'w') as f:
+    f.write(f'{jax.default_backend()}:{len(ds)}')
+" >/dev/null 2>&1 &
+  pid=$!
+  t0=$SECONDS
+  while [ $((SECONDS - t0)) -lt "$timeout_s" ]; do
+    if [ -f "$marker" ]; then
+      export FEI_TPU_ATTACH_DIAG="attach-ok:$(cat "$marker") in $((SECONDS - t0))s"
+      rm -f "$marker"
+      return 0
+    fi
+    if ! kill -0 "$pid" 2>/dev/null; then
+      export FEI_TPU_ATTACH_DIAG="attach-failed:probe exited nonzero in $((SECONDS - t0))s (backend down, not hung)"
+      return 1
+    fi
+    sleep 2
+  done
+  export FEI_TPU_ATTACH_DIAG="attach-hung:probe pid $pid still attaching after ${timeout_s}s (abandoned, not killed: killing mid-claim wedges the lease)"
+  return 2
+}

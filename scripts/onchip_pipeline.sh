@@ -69,6 +69,17 @@ stage() {
   report
 }
 
+# 0-pre. bounded attach watchdog (scripts/attach_probe.sh): a labeled
+# attach-ok / attach-failed / attach-hung verdict in the pipeline log,
+# and FEI_TPU_ATTACH_DIAG exported so EVERY bench stage's JSON line
+# carries the diagnosis. The probe is abandoned on timeout, never killed
+# (the lease rule above); the pipeline continues either way — bench
+# stages have their own labeled CPU fallback.
+. "$(dirname "$0")/attach_probe.sh"
+attach_probe "${ATTACH_TIMEOUT:-300}" || true
+echo "[$(date -u +%H:%M:%S)] attach watchdog: ${FEI_TPU_ATTACH_DIAG}" \
+  >> "$OUT/pipeline.log"
+
 # 0. tunnel latency + single-jit init characterization (session-local
 # probe; logs to stdout, which stage() captures)
 if [ -f /tmp/tpu_probe.py ]; then
@@ -123,6 +134,12 @@ stage preemption env FEI_TPU_TEST_PLATFORM=tpu python -m pytest \
   tests/test_preemption.py -q --timeout 600
 stage drain_restart env FEI_TPU_TEST_PLATFORM=tpu python -m pytest \
   tests/test_preemption.py::TestDrainRestart -q --timeout 600
+
+# 0d2. flight-recorder timeline smoke ON-CHIP: mixed workload (concurrent
+# admissions, turbo decode, organic preemption) against real device
+# dispatches, then /debug/timeline must return valid Chrome-trace JSON
+# with per-dispatch issue/sync spans tagged rid + mesh
+stage timeline python -u scripts/timeline_smoke.py
 
 # 0e. sharded serving (FEI_TPU_MESH): the tp×dp mesh as serving mode.
 # The parity/survival proofs need a multi-chip slice, so probe the

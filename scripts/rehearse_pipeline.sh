@@ -174,6 +174,12 @@ stage preemption -- python -m pytest tests/test_preemption.py -q --timeout 600
 stage drain_restart -- python -m pytest \
   tests/test_preemption.py::TestDrainRestart -q --timeout 600
 
+# --- flight-recorder timeline smoke: mixed workload (concurrent
+# admissions, turbo decode, an organic preemption), then /debug/timeline
+# must return valid Chrome-trace JSON with per-dispatch issue/sync spans
+# tagged rid + mesh (docs/OBSERVABILITY.md "Flight recorder") ----
+stage timeline -- python -u scripts/timeline_smoke.py
+
 echo
 echo "=== rehearsal results ==="
 for r in "${RESULTS[@]}"; do echo "$r"; done

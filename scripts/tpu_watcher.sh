@@ -2,21 +2,33 @@
 # Recovery watcher for the tunneled axon TPU backend. Probes attach in a
 # loop; when one succeeds, runs scripts/onchip_pipeline.sh once and exits.
 #
-# Probes are never killed: a client killed mid-claim wedges the chip lease
-# and every subsequent attach hangs until the lease expires. A down backend
-# fails fast with UNAVAILABLE; a wedged lease hangs-then-fails; both loop.
+# Each probe is BOUNDED by the attach watchdog (scripts/attach_probe.sh,
+# $ATTACH_TIMEOUT, default 300 s) so a wedged lease cannot hang the
+# watcher forever — but probes are never killed: a client killed
+# mid-claim wedges the chip lease and every subsequent attach hangs until
+# the lease expires. A down backend fails fast with attach-failed; a
+# wedged lease times out with attach-hung (probe abandoned to finish and
+# release its claim on its own schedule); both verdicts are logged and
+# the loop continues. A hung verdict backs off longer — the abandoned
+# probe is still in line for the lease.
 # Launch detached:  nohup bash scripts/tpu_watcher.sh >/dev/null 2>&1 &
 set -u
 LOG="${LOG:-/tmp/tpu_watch.log}"
+. "$(dirname "$0")/attach_probe.sh"
 echo "watcher start $(date -u)" >> "$LOG"
 while true; do
   t0=$(date +%s)
-  if python -c "import jax; jax.devices()" >> "$LOG" 2>&1; then
-    echo "ATTACH OK $(date -u) (probe took $(( $(date +%s) - t0 ))s)" >> "$LOG"
+  attach_probe "${ATTACH_TIMEOUT:-300}"
+  rc=$?
+  echo "$FEI_TPU_ATTACH_DIAG ($(date -u), probe took $(( $(date +%s) - t0 ))s)" >> "$LOG"
+  if [ "$rc" = 0 ]; then
     bash "$(dirname "$0")/onchip_pipeline.sh"
     echo "pipeline finished $(date -u)" >> "$LOG"
     exit 0
   fi
-  echo "probe failed $(date -u) (took $(( $(date +%s) - t0 ))s); sleeping 120s" >> "$LOG"
-  sleep 120
+  if [ "$rc" = 2 ]; then
+    sleep 300  # hung: the abandoned probe holds the line; back off longer
+  else
+    sleep 120
+  fi
 done

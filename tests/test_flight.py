@@ -1,0 +1,433 @@
+"""Flight recorder & performance attribution (fei_tpu/obs/flight.py,
+fei_tpu/obs/costmodel.py, docs/OBSERVABILITY.md "Flight recorder").
+
+The claims under test:
+- the ring is BOUNDED: under arbitrary event churn it never exceeds its
+  maxlen (env-knob ``FEI_TPU_FLIGHT_RING``, floor 16), evicting oldest
+  first, and optional ``FEI_TPU_FLIGHT_FILE`` spill is JSONL;
+- ``chrome_trace()`` is schema-valid Chrome-trace JSON: every dispatch
+  becomes an ``<name>.issue`` / ``<name>.sync`` complete-event pair with
+  µs timestamps, non-negative durations, and rid/mesh/slot tags in args;
+- recorder dispatch totals MATCH the metrics counters: one
+  ``dispatch.decode`` record per ``engine.decode_dispatches`` increment
+  (dense path), and on the paged scheduler one ``dispatch.step`` record
+  per batched device dispatch — the identity
+  ``dispatch.step == (decode_steps − multi_tokens) + multi_steps``
+  (each multi-step turbo dispatch adds N to decode_steps but is ONE
+  device program launch);
+- the compile observer counts first builds per program signature and
+  flags any signature compiled twice as a steady-state recompile; a
+  warmed engine re-running an identical workload shows ZERO new
+  compiles and zero recompiles, while deliberately dropping a jit cache
+  reads as a recompile (the silent-20s-shard_map-recompile tripwire);
+- the analytical cost model matches hand-computed arithmetic from the
+  model config (weights-minus-embed stream, K/V row bytes), and the live
+  roofline gauges are populated by real scheduler dispatches;
+- a KV-pressure preempt → resume round trip leaves rid-tagged
+  ``preempt`` / ``resume`` / ``admit`` instants on the timeline,
+  retrievable per-request via ``for_rid`` and ``GET /v1/traces/<id>``.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+
+import jax.numpy as jnp
+import pytest
+
+from fei_tpu.engine.engine import GenerationConfig, InferenceEngine
+from fei_tpu.obs import FLIGHT, CompileObserver, FlightRecorder
+from fei_tpu.obs import costmodel
+from fei_tpu.utils.metrics import METRICS
+
+PROMPT = list(range(11, 29))
+PROMPTS = [list(range(11 + i, 29 + i)) for i in range(4)]
+
+
+def _counter(name: str) -> float:
+    return METRICS.snapshot()["counters"].get(name, 0)
+
+
+def _gauge(name: str) -> float:
+    return METRICS.snapshot()["gauges"].get(name, 0)
+
+
+def _gen(**kw) -> GenerationConfig:
+    kw.setdefault("max_new_tokens", 24)
+    kw.setdefault("temperature", 0.0)
+    kw.setdefault("ignore_eos", True)
+    return GenerationConfig(**kw)
+
+
+@pytest.fixture(scope="module")
+def engine():
+    return InferenceEngine.from_config(
+        "tiny", dtype=jnp.float32, max_seq_len=128
+    )
+
+
+# ---------------------------------------------------------------------------
+# ring bounds & spill
+
+
+class TestRing:
+    def test_bounded_under_churn(self):
+        r = FlightRecorder(maxlen=32)
+        for i in range(1000):
+            r.event("churn", rid=f"req-{i}")
+            r.dispatch("dispatch.decode", 0.0, 1.0, 2.0, rid=f"req-{i}")
+        assert len(r) == 32
+        recs = r.records()
+        assert len(recs) == 32
+        # oldest evicted first: only the newest records survive
+        assert recs[-1]["tags"]["rid"] == "req-999"
+        assert all(
+            int(rec["tags"]["rid"].split("-")[1]) >= 1000 - 16
+            for rec in recs
+        )
+        assert sum(r.counts().values()) == 32
+
+    def test_maxlen_floor(self):
+        r = FlightRecorder(maxlen=1)
+        for i in range(50):
+            r.event("e")
+        assert len(r) == 16  # floor, not 1
+
+    def test_env_knob(self, monkeypatch):
+        monkeypatch.setenv("FEI_TPU_FLIGHT_RING", "64")
+        assert FlightRecorder()._ring.maxlen == 64
+        monkeypatch.setenv("FEI_TPU_FLIGHT_RING", "3")
+        assert FlightRecorder()._ring.maxlen == 16
+        monkeypatch.setenv("FEI_TPU_FLIGHT_RING", "not-a-number")
+        assert FlightRecorder()._ring.maxlen == 4096
+
+    def test_reset(self):
+        r = FlightRecorder(maxlen=32)
+        r.event("e")
+        assert len(r) == 1
+        r.reset()
+        assert len(r) == 0
+        assert r.records() == []
+
+    def test_spill_jsonl(self, tmp_path, monkeypatch):
+        path = tmp_path / "flight.jsonl"
+        monkeypatch.setenv("FEI_TPU_FLIGHT_FILE", str(path))
+        r = FlightRecorder(maxlen=32)
+        r.event("preempt", rid="req-1", slot=0)
+        r.dispatch("dispatch.decode", 1.0, 1.25, 2.0, rid="req-1")
+        lines = path.read_text().splitlines()
+        assert len(lines) == 2
+        first, second = (json.loads(ln) for ln in lines)
+        assert first["kind"] == "instant" and first["name"] == "preempt"
+        assert second["kind"] == "dispatch"
+        assert second["issue_s"] == pytest.approx(0.25)
+        assert second["sync_s"] == pytest.approx(0.75)
+
+    def test_spill_failure_is_swallowed(self, tmp_path, monkeypatch):
+        # a directory path makes open(..., "a") raise OSError; recording
+        # must survive — flight recording never takes down serving
+        monkeypatch.setenv("FEI_TPU_FLIGHT_FILE", str(tmp_path))
+        r = FlightRecorder(maxlen=32)
+        r.event("e")
+        assert len(r) == 1
+
+
+# ---------------------------------------------------------------------------
+# Chrome-trace export schema
+
+
+class TestChromeTrace:
+    def _recorder(self) -> FlightRecorder:
+        r = FlightRecorder(maxlen=64)
+        r.event("preempt", rid="req-1", slot=0, generated=7)
+        r.dispatch(
+            "dispatch.decode", 1.0, 1.5, 2.25,
+            rid="req-1", mesh="ms1", slot=0, n_steps=1,
+        )
+        r.dispatch(
+            "dispatch.step", 3.0, 3.1, 3.6,
+            rids=["req-1", "req-2"], mesh="tp2", n_steps=4,
+        )
+        return r
+
+    def test_schema(self):
+        trace = json.loads(json.dumps(self._recorder().chrome_trace()))
+        events = trace["traceEvents"]
+        assert trace["displayTimeUnit"] == "ms"
+        assert len(events) == 5  # 1 instant + 2 dispatches × (issue+sync)
+        for e in events:
+            assert e["ph"] in ("i", "X")
+            assert e["pid"] == 1 and e["tid"] == 1
+            assert isinstance(e["args"], dict)
+            if e["ph"] == "X":
+                assert e["dur"] >= 0
+
+    def test_issue_sync_split(self):
+        events = self._recorder().chrome_trace()["traceEvents"]
+        issues = [e for e in events if e["name"].endswith(".issue")]
+        syncs = [e for e in events if e["name"].endswith(".sync")]
+        assert len(issues) == len(syncs) == 2
+        iss = next(e for e in issues if e["name"] == "dispatch.decode.issue")
+        syn = next(e for e in syncs if e["name"] == "dispatch.decode.sync")
+        # µs timestamps: issue spans [t0, t_issue), sync [t_issue, t1)
+        assert iss["ts"] == pytest.approx(1.0e6)
+        assert iss["dur"] == pytest.approx(0.5e6)
+        assert syn["ts"] == pytest.approx(1.5e6)
+        assert syn["dur"] == pytest.approx(0.75e6)
+        assert iss["args"]["rid"] == "req-1"
+        assert iss["args"]["mesh"] == "ms1"
+        assert iss["args"]["slot"] == 0
+
+    def test_negative_durations_clamped(self):
+        r = FlightRecorder(maxlen=16)
+        r.dispatch("dispatch.decode", 2.0, 1.0, 0.5)  # clock went backwards
+        for e in r.chrome_trace()["traceEvents"]:
+            assert e["dur"] == 0.0
+
+    def test_for_rid(self):
+        r = self._recorder()
+        slice1 = r.for_rid("req-1")
+        assert len(slice1) == 3  # instant + single-rid + batched rids
+        assert {rec["kind"] for rec in slice1} == {"instant", "dispatch"}
+        slice2 = r.for_rid("req-2")
+        assert len(slice2) == 1  # only the batched dispatch
+        assert slice2[0]["name"] == "dispatch.step"
+        assert r.for_rid("req-nope") == []
+
+
+# ---------------------------------------------------------------------------
+# compile observer
+
+
+class TestCompileObserver:
+    def test_first_build_counts_compile(self):
+        obs = CompileObserver()
+        c0, r0 = _counter("engine.compiles"), _counter("engine.recompiles")
+        f = obs.wrap("test.family", (1, 128), lambda x: x + 1)
+        g = obs.wrap("test.family", (1, 256), lambda x: x + 2)
+        assert _counter("engine.compiles") - c0 == 2
+        assert _counter("engine.recompiles") - r0 == 0
+        assert f(1) == 2 and g(1) == 3  # wrapped fns still compute
+
+    def test_second_miss_is_recompile(self):
+        obs = CompileObserver()
+        FLIGHT.reset()
+        c0, r0 = _counter("engine.compiles"), _counter("engine.recompiles")
+        obs.wrap("test.family", (1, 128), lambda x: x)
+        obs.wrap("test.family", (1, 128), lambda x: x)  # cache was dropped
+        assert _counter("engine.compiles") - c0 == 1
+        assert _counter("engine.recompiles") - r0 == 1
+        assert FLIGHT.counts()["recompile"] == 1
+
+    def test_first_invocation_timed(self):
+        obs = CompileObserver()
+        FLIGHT.reset()
+        f = obs.wrap("test.family", 0, lambda x: x * 2)
+        assert f(3) == 6
+        assert f(4) == 8
+        compiles = [r for r in FLIGHT.records() if r["name"] == "compile"]
+        assert len(compiles) == 1  # only the first call is the build
+        assert compiles[0]["tags"]["family"] == "test.family"
+        assert compiles[0]["tags"]["seconds"] >= 0
+
+
+# ---------------------------------------------------------------------------
+# dense-engine attribution: parity, forced re-jit, steady state
+
+
+class TestDenseAttribution:
+    def test_dispatch_count_parity(self, engine):
+        FLIGHT.reset()
+        d0 = _counter("engine.decode_dispatches")
+        gen = _gen(max_new_tokens=8, chunk=1)
+        toks = list(engine.generate_stream(PROMPT, gen))
+        assert len(toks) == 8
+        counts = FLIGHT.counts()
+        assert counts["dispatch.decode"] == (
+            _counter("engine.decode_dispatches") - d0
+        )
+        assert counts["dispatch.prefill"] >= 1
+        # per-dispatch host spans landed alongside the flight records
+        spans = METRICS.snapshot()["spans"]
+        assert spans["dispatch_issue"]["count"] >= counts["dispatch.decode"]
+        assert spans["dispatch_sync"]["count"] >= counts["dispatch.decode"]
+
+    def test_fused_path_parity(self, engine):
+        FLIGHT.reset()
+        d0 = _counter("engine.decode_dispatches")
+        toks = list(engine.generate_stream(PROMPT, _gen(max_new_tokens=12)))
+        assert len(toks) == 12
+        assert FLIGHT.counts()["dispatch.decode"] == (
+            _counter("engine.decode_dispatches") - d0
+        )
+
+    def test_steady_state_zero_recompiles(self, engine):
+        gen = _gen(max_new_tokens=6, chunk=1)
+        list(engine.generate_stream(PROMPT, gen))  # warm every jit cache
+        c0, r0 = _counter("engine.compiles"), _counter("engine.recompiles")
+        list(engine.generate_stream(PROMPT, gen))
+        list(engine.generate_stream(PROMPT, gen))
+        assert _counter("engine.compiles") - c0 == 0
+        assert _counter("engine.recompiles") - r0 == 0
+
+    def test_forced_rejit_detected(self, engine):
+        gen = _gen(max_new_tokens=4, chunk=1)
+        list(engine.generate_stream(PROMPT, gen))  # ensure warm
+        FLIGHT.reset()
+        r0 = _counter("engine.recompiles")
+        engine._step_cache.clear()  # drop the jit cache: signature leaks
+        list(engine.generate_stream(PROMPT, gen))
+        assert _counter("engine.recompiles") - r0 >= 1
+        assert FLIGHT.counts()["recompile"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# paged scheduler: step parity, preempt→resume flight, roofline gauges
+
+
+class TestSchedulerFlight:
+    @pytest.fixture(scope="class")
+    def flown(self):
+        """One tight-pool concurrent run (the test_preemption geometry:
+        two worst-case reservations cannot share 13 allocatable pages, so
+        preemption triggers organically) with counter deltas captured."""
+        engine = InferenceEngine.from_config(
+            "tiny", paged=True, batch_size=2, page_size=4, num_pages=14,
+            prefix_cache=True,
+        )
+        sched = engine.scheduler
+        FLIGHT.reset()
+        before = {
+            name: _counter(f"scheduler.{name}")
+            for name in ("decode_steps", "multi_steps", "multi_tokens")
+        }
+        seqs = [sched.submit(p, _gen()) for p in PROMPTS]
+        results: list = [None] * len(seqs)
+
+        def go(i):
+            results[i] = list(sched.drain(seqs[i]))
+
+        ts = [threading.Thread(target=go, args=(i,))
+              for i in range(len(seqs))]
+        [t.start() for t in ts]
+        [t.join(timeout=300) for t in ts]
+        assert all(r for r in results), "a stream never finished"
+        deltas = {
+            name: _counter(f"scheduler.{name}") - before[name]
+            for name in before
+        }
+        return engine, seqs, deltas
+
+    def test_dispatch_step_parity(self, flown):
+        _, _, d = flown
+        # each multi-step turbo dispatch adds N to decode_steps but is
+        # ONE device program launch — one flight record
+        expected = (d["decode_steps"] - d["multi_tokens"]) + d["multi_steps"]
+        assert expected > 0
+        assert FLIGHT.counts()["dispatch.step"] == expected
+
+    def test_preempt_resume_round_trip(self, flown):
+        counts = FLIGHT.counts()
+        assert counts["preempt"] >= 1
+        assert counts["resume"] >= 1
+        assert counts["admit"] >= len(PROMPTS)
+        preempts = [r for r in FLIGHT.records() if r["name"] == "preempt"]
+        rid = preempts[0]["tags"]["rid"]
+        names = [r["name"] for r in FLIGHT.for_rid(rid)]
+        assert "preempt" in names and "resume" in names
+        assert "admit" in names  # admitted at least once, rid-tagged
+        resumed = next(r for r in FLIGHT.for_rid(rid)
+                       if r["name"] == "resume")
+        assert resumed["tags"]["generated"] >= 1
+
+    def test_roofline_gauges_live(self, flown):
+        assert _gauge("roofline.frac") > 0
+        assert _gauge("roofline.tok_s_per_chip") > 0
+
+    def test_timeline_endpoint_end_to_end(self, flown):
+        from fei_tpu.ui.server import ServeAPI
+
+        _, seqs, _ = flown
+        api = ServeAPI(provider=None)
+        status, payload = api.handle("GET", "/debug/timeline", {}, {})[:2]
+        assert status == 200
+        trace = json.loads(json.dumps(payload))
+        events = trace["traceEvents"]
+        issues = [e for e in events if e["ph"] == "X"
+                  and e["name"].endswith(".issue")]
+        syncs = [e for e in events if e["ph"] == "X"
+                 and e["name"].endswith(".sync")]
+        assert issues and len(issues) == len(syncs)
+        for e in issues:
+            if e["name"].startswith("dispatch.step"):
+                assert "mesh" in e["args"]
+                assert e["args"].get("rids")
+        status, payload = api.handle(
+            "GET", f"/v1/traces/{seqs[0].rid}", {}, {}
+        )[:2]
+        assert status == 200
+        assert payload["id"] == seqs[0].rid
+        assert payload["flight"], "trace fetch missing its flight slice"
+        status, _ = api.handle("GET", "/v1/traces/req-nope", {}, {})[:2]
+        assert status == 404
+
+
+# ---------------------------------------------------------------------------
+# analytical cost model vs hand-computed config arithmetic
+
+
+class TestCostModel:
+    def test_kv_row_bytes(self, engine):
+        cfg = engine.cfg
+        # 2 (K and V) × layers × kv_heads × head_dim × fp32
+        expected = 2 * cfg.num_layers * cfg.num_kv_heads * cfg.head_dim_ * 4
+        assert costmodel.kv_row_bytes(engine) == expected == 512
+
+    def test_decode_stream_bytes_vs_hand_computed(self, engine):
+        cfg = engine.cfg
+        sb = costmodel.decode_stream_bytes(engine, mean_ctx=32)
+        # hand-computed from the config card: every parameter streams
+        # except the (untied) embedding table, which is a one-row gather
+        hand_weights = (cfg.num_params() - cfg.vocab_size
+                        * cfg.hidden_size) * 4
+        assert sb["weights"] == pytest.approx(hand_weights, rel=0.05)
+        assert sb["kv_read"] == 512 * 32
+        assert sb["kv_write"] == 512
+        assert sb["total"] == sb["weights"] + sb["kv_read"] + sb["kv_write"]
+
+    def test_dispatch_bytes(self, engine):
+        sb = costmodel.decode_stream_bytes(engine, 0)
+        got = costmodel.dispatch_bytes(
+            engine, n_steps=4, total_ctx=100, slots=2
+        )
+        assert got == 4 * (sb["weights"] + 512 * 102)
+        # n_steps floor: a degenerate dispatch still streams once
+        assert costmodel.dispatch_bytes(engine, 0, 0, 1) > 0
+
+    def test_decode_flops_vs_active_params(self, engine):
+        got = costmodel.decode_flops_per_token(engine)
+        assert got == pytest.approx(
+            2 * engine.cfg.num_active_params(), rel=0.10
+        )
+
+    def test_roofline_fraction(self, monkeypatch):
+        monkeypatch.setenv("FEI_TPU_HBM_GBPS", "100")
+        assert costmodel.hbm_gbps() == 100.0
+        assert costmodel.roofline_fraction(int(50e9), 1.0) == (
+            pytest.approx(0.5)
+        )
+        assert costmodel.roofline_fraction(int(50e9), 1.0, n_chips=2) == (
+            pytest.approx(0.25)
+        )
+        assert costmodel.roofline_fraction(int(50e9), 0.0) == 0.0
+        monkeypatch.setenv("FEI_TPU_HBM_GBPS", "bogus")
+        assert costmodel.hbm_gbps() == costmodel.V5E_HBM_GBPS
+
+    def test_chips_for_tag(self):
+        assert costmodel.chips_for_tag(None) == 1
+        assert costmodel.chips_for_tag("ms1") == 1
+        assert costmodel.chips_for_tag("off") == 1
+        assert costmodel.chips_for_tag("tp2") == 2
+        assert costmodel.chips_for_tag("tp2dp2") == 4
+        assert costmodel.chips_for_tag("??junk??") == 1
