@@ -37,6 +37,13 @@ Suites (FEI_TPU_BENCH_SUITE):
                      aggregate tok/s, slot counts (dp multiplies them) and
                      a greedy token-parity probe vs the ms1 rung; on a CPU
                      backend it re-execs onto the 8-device host mesh
+  fleet            — bursty multi-tenant overload through the fleet router
+                     (2 in-process replicas): per-tenant p99 TTFT, goodput
+                     and shed counts at ~2x capacity, with a zero-downtime
+                     rolling restart mid-burst. The QoS claims live in the
+                     extras: gold (priority 2) p99 vs its unloaded
+                     baseline, and the share of sheds absorbed by bronze
+                     (priority 0)
 
 Knobs:
   FEI_TPU_BENCH_MODEL    (decode default llama3-8b — the BASELINE config #2
@@ -899,6 +906,175 @@ def bench_federation(n_tokens: int) -> int:
     return _emit("federation_4node_embed_allgather_GBps", gbps, unit="GB/s")
 
 
+def bench_fleet(model: str, n_tokens: int) -> int:
+    """Bursty multi-tenant overload through the fleet front door.
+
+    Two in-process replicas (tiny paged engines behind ServeAPI cores)
+    sit behind fei_tpu.fleet.Router with FEI_TPU_TENANT_BUDGETS
+    gold:4/silver:2/bronze:1 and a deliberately small waiting queue, so
+    ~2x-capacity concurrent sessions MUST overflow. The shape of the
+    degradation is the measurement: bronze (priority 0) absorbs the
+    sheds and queue evictions, gold (priority 2) keeps a bounded p99
+    TTFT vs its own unloaded baseline. A rolling restart fires
+    mid-burst; any stream that had tokens flowing and then died counts
+    as a dropped accepted request (the zero-downtime claim wants 0).
+
+    FEI_TPU_BENCH_SESSIONS (default 18; raise on-chip) sets burst width,
+    FEI_TPU_BENCH_ROUNDS (default 2) requests per session."""
+    import tempfile
+    import threading
+
+    from fei_tpu.agent.providers import JaxLocalProvider
+    from fei_tpu.fleet import InProcessReplica, Router
+    from fei_tpu.fleet.router import _parse_sse
+    from fei_tpu.ui.server import ServeAPI
+
+    # QoS knobs land before any engine exists (TenantBook reads env at
+    # scheduler construction)
+    os.environ.setdefault("FEI_TPU_TENANT_BUDGETS", "gold:4,silver:2,bronze:1")
+    os.environ.setdefault("FEI_TPU_MAX_QUEUE", "3")
+    sessions = int(os.environ.get("FEI_TPU_BENCH_SESSIONS", "18"))
+    rounds = int(os.environ.get("FEI_TPU_BENCH_ROUNDS", "2"))
+    budget = min(n_tokens, 24)
+
+    def factory():
+        engine = _make_engine(
+            model, max_seq_len=512, paged=True, batch_size=2, page_size=16,
+        )
+        return ServeAPI(JaxLocalProvider(engine=engine), model_name="fleet")
+
+    replicas = [
+        InProcessReplica(
+            f"r{i}", factory=factory,
+            drain_dir=tempfile.mkdtemp(prefix=f"fei-fleet-r{i}-"),
+        )
+        for i in range(2)
+    ]
+    router = Router(replicas, health_ttl_s=0.2, breaker_cooldown_s=0.5)
+
+    tenants = [("gold", 2), ("silver", 1), ("bronze", 0)]
+    weights = {"gold": 4.0, "silver": 2.0, "bronze": 1.0}
+
+    def one_request(tenant: str, priority: int, session: str):
+        body = {
+            "messages": [{"role": "user",
+                          "content": f"fleet bench {tenant} {session}"}],
+            "max_tokens": budget, "temperature": 0,
+            "tenant": tenant, "priority": priority, "session": session,
+        }
+        t0 = time.perf_counter()
+        ttft, tokens, err = None, 0, None
+        for chunk in router.stream_chat(body, {}):
+            info = _parse_sse(chunk)
+            if info is None:
+                continue
+            if info.get("error"):
+                err = dict(info["error"])
+                break
+            delta = (info.get("choices") or [{}])[0].get("delta") or {}
+            if delta.get("content"):
+                tokens += 1
+                if ttft is None:
+                    ttft = time.perf_counter() - t0
+        return {"tenant": tenant, "ttft": ttft, "tokens": tokens,
+                "error": err}
+
+    # -- unloaded baseline: gold alone, sequential --------------------------
+    log("bench: fleet unloaded gold baseline...")
+    base = [one_request("gold", 2, f"gold-base-{i}") for i in range(4)]
+    base_ttfts = sorted(r["ttft"] for r in base if r["ttft"] is not None)
+    if not base_ttfts:
+        raise RuntimeError(f"fleet baseline produced no tokens: {base}")
+    base_p99 = base_ttfts[int(0.99 * (len(base_ttfts) - 1))]
+    log(f"bench: fleet unloaded gold p99 ttft={base_p99*1000:.1f}ms")
+
+    # -- 2x-overload burst + rolling restart mid-stream ---------------------
+    results: list[dict] = []
+    res_lock = threading.Lock()
+
+    def session_worker(idx: int):
+        tenant, priority = tenants[idx % len(tenants)]
+        for r in range(rounds):
+            out = one_request(tenant, priority, f"{tenant}-s{idx}")
+            with res_lock:
+                results.append(out)
+
+    restart_report: dict = {}
+
+    def do_restart():
+        time.sleep(1.0)  # let the burst saturate first
+        restart_report.update(router.rolling_restart(
+            drain_deadline_s=60.0, wait_s=120.0
+        ))
+
+    log(f"bench: fleet overload burst: {sessions} sessions x {rounds} "
+        f"rounds across {len(tenants)} tenants, restart mid-burst...")
+    t0 = time.time()
+    workers = [threading.Thread(target=session_worker, args=(i,))
+               for i in range(sessions)]
+    restarter = threading.Thread(target=do_restart)
+    [w.start() for w in workers]
+    restarter.start()
+    [w.join() for w in workers]
+    restarter.join()
+    dt = time.time() - t0
+
+    per: dict[str, dict] = {
+        t: {"served": 0, "tokens": 0, "sheds": 0, "ttfts": []}
+        for t, _ in tenants
+    }
+    dropped = 0
+    for r in results:
+        b = per[r["tenant"]]
+        if r["error"] is not None and r["tokens"] == 0:
+            b["sheds"] += 1
+            continue
+        if r["error"] is not None:
+            dropped += 1  # accepted (tokens flowed), then died
+            continue
+        b["served"] += 1
+        b["tokens"] += r["tokens"]
+        if r["ttft"] is not None:
+            b["ttfts"].append(r["ttft"])
+
+    total_tokens = sum(b["tokens"] for b in per.values())
+    total_sheds = sum(b["sheds"] for b in per.values())
+    extra: dict = {"per_tenant": {}, "unloaded_gold_p99_ttft_ms":
+                   round(base_p99 * 1000, 1)}
+    for t, _ in tenants:
+        b = per[t]
+        ts = sorted(b["ttfts"])
+        p99 = ts[int(0.99 * (len(ts) - 1))] if ts else None
+        extra["per_tenant"][t] = {
+            "served": b["served"], "tokens": b["tokens"],
+            "sheds": b["sheds"],
+            "p99_ttft_ms": round(p99 * 1000, 1) if p99 else None,
+            "goodput_per_weight": round(b["tokens"] / weights[t], 2),
+        }
+        log(f"bench: fleet tenant {t}: served={b['served']} "
+            f"tokens={b['tokens']} sheds={b['sheds']} "
+            f"p99_ttft={p99*1000:.1f}ms" if p99 else
+            f"bench: fleet tenant {t}: served={b['served']} "
+            f"tokens={b['tokens']} sheds={b['sheds']} (no ttft)")
+    gold_ts = sorted(per["gold"]["ttfts"])
+    if gold_ts:
+        gold_p99 = gold_ts[int(0.99 * (len(gold_ts) - 1))]
+        extra["gold_p99_vs_unloaded"] = round(gold_p99 / base_p99, 3)
+    extra["bronze_shed_share"] = (
+        round(per["bronze"]["sheds"] / total_sheds, 3) if total_sheds else None
+    )
+    extra["total_sheds"] = total_sheds
+    extra["restart_dropped_accepted"] = dropped
+    extra["rolling_restart"] = restart_report
+    extra["sessions"] = sessions
+    log(f"bench: fleet burst done in {dt:.1f}s: {total_tokens} tokens, "
+        f"{total_sheds} sheds (bronze share "
+        f"{extra['bronze_shed_share']}), dropped_accepted={dropped}, "
+        f"restart={restart_report}")
+    return _emit("fleet_2replica_overload_agg_tok_s", total_tokens / dt,
+                 unit="tok/s", extra=extra)
+
+
 def bench_agent(model: str, n_tokens: int) -> int:
     """End-to-end `fei --message` shape (BASELINE config #3): chat template
     -> jax_local provider -> engine stream -> incremental detokenize ->
@@ -1030,6 +1206,10 @@ def main() -> int:
         os.execv(sys.executable, [sys.executable] + sys.argv)
     if suite == "moe":
         default_model = "moe-2b"
+    elif suite == "fleet":
+        # two engines in one process: tiny keeps the burst about QoS
+        # shape, not model weight; override with FEI_TPU_BENCH_MODEL
+        default_model = "tiny"
     elif suite == "decode":
         # BASELINE config #2 gate scale: Llama-3-8B on ONE chip. int8
         # weight-only (~8 GB) is what makes 8B + KV fit the 16 GB v5e;
@@ -1073,6 +1253,8 @@ def main() -> int:
         return bench_sharded(model, n_tokens)
     if suite == "moe":
         return bench_moe(model, n_tokens)
+    if suite == "fleet":
+        return bench_fleet(model, n_tokens)
     if suite == "agent":
         return bench_agent(model, n_tokens)
     return bench_decode(model, n_tokens)

@@ -62,6 +62,13 @@ class GenerationConfig:
     # queue wait sheds) and at delivery (mid-decode cancel,
     # ``deadline_exceeded`` in traces); the dense path ignores it.
     deadline_s: float = 0.0
+    # multi-tenant QoS (engine/tenancy.py): "" resolves to
+    # FEI_TPU_DEFAULT_TENANT at submit. Admission is weighted-fair across
+    # tenants; higher priority admits first, sheds last, and may preempt
+    # strictly-lower-priority victims when slots are full. The dense
+    # single-stream path ignores both.
+    tenant: str = ""
+    priority: int = 0
 
 
 @dataclass

@@ -24,10 +24,14 @@ Points (the lint-style registry below is the source of truth):
 - ``provider.http``      — before each remote HTTP attempt
 - ``delivery.detok``     — per-token delivery (grammar walk/emission)
 - ``pool.alloc``         — inside the scheduler's page-allocation seam
+- ``router.forward``     — fleet router, before forwarding to a replica
+- ``replica.health``     — fleet router, before a replica health probe
 
 Kinds map to exception types: ``request`` → RequestError, ``device`` →
 DeviceError, ``conn`` → urllib URLError, ``http429``/``http503`` →
 urllib HTTPError (with Retry-After: 0 so retry tests stay fast), and
+``hang`` → TimeoutError (a replica that never answers, surfaced as the
+router's post-timeout error), and
 ``exhausted``/``transient`` → PoolPressure (``pool.alloc`` only: the
 scheduler's pressure handler swallows it like a real exhaustion, so the
 chaos sweep exercises preemption with a full-size pool; ``transient``
@@ -59,11 +63,13 @@ POINTS = (
     "provider.http",
     "delivery.detok",
     "pool.alloc",
+    "router.forward",    # fleet router: before a forward to a replica
+    "replica.health",    # fleet router: before a replica health probe
 )
 
 KINDS = (
     "request", "device", "conn", "http429", "http503",
-    "exhausted", "transient",
+    "exhausted", "transient", "hang",
 )
 
 
@@ -75,6 +81,12 @@ def _make_exc(kind: str, point: str) -> BaseException:
         return DeviceError(msg)
     if kind in ("exhausted", "transient"):
         return PoolPressure(msg)
+    if kind == "hang":
+        # a replica that accepts the connection and never answers: the
+        # router's socket timeout is what a real hang turns into, so the
+        # injection raises the post-timeout error directly (a blocking
+        # sleep would serialize the chaos sweep)
+        return TimeoutError(msg)
     import io
     import urllib.error
     from email.message import Message

@@ -40,9 +40,14 @@ class AdmissionMixin:
     """Request admission: queue -> slot -> prefilled pages -> first token."""
 
     def _admit_ready(self) -> None:
-        """FIFO admission: fill free slots while the pool has pages. Head-of-
-        line blocking is deliberate — it guarantees a too-big-for-now request
-        eventually runs instead of starving behind smaller latecomers.
+        """Admission: fill free slots while the pool has pages. The next
+        request comes from _next_admission_locked — plain FIFO for
+        uniform-priority single-tenant traffic, weighted-fair across
+        tenants with priority classes otherwise (a high-priority arrival
+        may preempt a strictly lower-priority slot). Head-of-line
+        blocking on the CHOSEN candidate is deliberate — it guarantees a
+        too-big-for-now request eventually runs instead of starving
+        behind smaller latecomers.
 
         A chunked admission in flight gets exactly one chunk of prefill per
         call, so the caller's loop interleaves it with decode steps — and
@@ -63,10 +68,35 @@ class AdmissionMixin:
                 self._shed_expired_locked()
                 if not self._waiting:
                     return
+                seq = self._next_admission_locked()
+                if seq is None:
+                    # every waiting tenant in EVERY class is over budget
+                    return
                 free = [b for b, s in enumerate(self._slots) if s is None]
                 if not free:
-                    return
-                seq = self._waiting[0]
+                    # priority slot preemption: a waiting request may evict
+                    # a STRICTLY lower-priority running sequence through
+                    # the snapshot/resume ladder (it resumes byte-
+                    # identically once a slot frees) — equal classes never
+                    # preempt each other for slots, so uniform-priority
+                    # traffic keeps the legacy wait-for-a-slot behavior
+                    if self.preempt_policy == "off" or seq.priority <= 0:
+                        return
+                    victim = self._pick_victim(
+                        exclude=None, max_priority=seq.priority - 1
+                    )
+                    if victim is None:
+                        return
+                    METRICS.incr("scheduler.priority_preemptions")
+                    FLIGHT.event(
+                        "priority_preempt", rid=victim.rid,
+                        by_rid=seq.rid, priority=victim.priority,
+                        by_priority=seq.priority,
+                    )
+                    self._preempt_seq(victim, locked=True)
+                    free = [b for b, s in enumerate(self._slots) if s is None]
+                    if not free:
+                        return
                 alloc = self.engine._allocator
                 # a preempted sequence re-prefills prompt + generated[:-1]
                 # — its prefix match, page demand, and prefill routing are
@@ -129,7 +159,7 @@ class AdmissionMixin:
                             # registry instead
                             seq.prefix_match = None
                         return
-                self._waiting.popleft()
+                self._waiting.remove(seq)
                 slot = free[0]
                 self._slots[slot] = seq
                 seq.slot = slot
@@ -201,6 +231,61 @@ class AdmissionMixin:
             except BaseException as exc:  # noqa: BLE001
                 self._abort_admission(seq, slot, exc)
 
+
+    def _next_admission_locked(self) -> object | None:
+        """The request the next admission should take from the waiting
+        queue (left in place — the caller removes it once a slot and
+        pages are committed). Runs under self._lock.
+
+        Uniform priorities with no FEI_TPU_TENANT_BUDGETS table degrade
+        to EXACTLY the legacy FIFO head (head-of-line blocking and its
+        no-starvation guarantee included). Otherwise: the highest
+        waiting priority class admits first; within it, the backlogged
+        tenant with the least weighted-fair virtual time (tenancy.
+        TenantBook, FIFO within each tenant), skipping tenants whose
+        running sequences already hold their token budget. A tenant
+        with NOTHING running always gets a floor of one admission, so a
+        budget smaller than one request cannot starve it forever. A
+        class whose every tenant is budget-deferred falls through to
+        the next lower class — admission stays WORK-CONSERVING: free
+        slots never sit idle behind a budget-capped high-priority
+        tenant's deep queue."""
+        if not self._waiting:
+            return None
+        book = self.tenants
+        first = self._waiting[0]
+        uniform = all(s.priority == first.priority for s in self._waiting)
+        if uniform and not book.configured:
+            return first
+        # reserved token positions per tenant across the running slots
+        inflight: dict[str, int] = {}
+        for s in self._slots:
+            if s is not None and not s.finished:
+                inflight[s.tenant] = inflight.get(s.tenant, 0) + min(
+                    len(s.prompt_ids) + s.budget, self.engine.max_seq_len
+                )
+        for top in sorted({s.priority for s in self._waiting}, reverse=True):
+            best = None
+            best_v = None
+            seen: set[str] = set()
+            for s in self._waiting:  # deque order: FIFO within each tenant
+                if s.priority != top or s.tenant in seen:
+                    continue
+                seen.add(s.tenant)
+                pol = book.policy(s.tenant)
+                if pol.token_budget and inflight.get(s.tenant, 0) > 0:
+                    need = min(
+                        len(s.prompt_ids) + s.budget, self.engine.max_seq_len
+                    )
+                    if inflight[s.tenant] + need > pol.token_budget:
+                        METRICS.incr("scheduler.tenant_budget_deferred")
+                        continue
+                v = book.vtime(s.tenant)
+                if best_v is None or v < best_v:
+                    best, best_v = s, v
+            if best is not None:
+                return best
+        return None
 
     def _shed_expired_locked(self) -> None:
         """Drop queued requests whose wait already blew their deadline —

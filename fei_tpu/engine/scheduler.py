@@ -133,6 +133,11 @@ class _Seq:
     lazy: bool = False
     replay: bool = False
     shield: bool = False
+    # multi-tenant QoS (engine/tenancy.py): admission is weighted-fair
+    # across tenants; priority orders the victim ladder (lower classes
+    # preempt and shed first) and queue-full eviction
+    tenant: str = "default"
+    priority: int = 0
 
 
 class PagedScheduler(AdmissionMixin, DecodeMixin, ConstraintMixin):
@@ -246,6 +251,13 @@ class PagedScheduler(AdmissionMixin, DecodeMixin, ConstraintMixin):
         # re-admission) instead of raising. "off" restores the legacy
         # behavior: full worst-case reservation at admission, blocking
         # head-of-line when the pool is tight, no preemption.
+        # multi-tenant QoS: the policy table (weights, queue caps, token
+        # budgets) plus per-tenant weighted-fair virtual time. With no
+        # FEI_TPU_TENANT_BUDGETS configured and uniform priorities the
+        # admission order is exactly the legacy FIFO.
+        from fei_tpu.engine.tenancy import TenantBook
+
+        self.tenants = TenantBook()
         self.preempt_policy = _os.environ.get(
             "FEI_TPU_PREEMPT_POLICY", "min-progress"
         )
@@ -348,17 +360,13 @@ class PagedScheduler(AdmissionMixin, DecodeMixin, ConstraintMixin):
                     self._degraded_until - time.monotonic(),
                 ),
             )
-        if self.max_queue:
-            with self._lock:
-                depth = len(self._waiting)
-            if depth >= self.max_queue:
-                METRICS.incr("scheduler.requests_shed")
-                METRICS.gauge("scheduler.queue_depth", depth)
-                raise QueueFullError(
-                    f"waiting queue is full ({depth} >= FEI_TPU_MAX_QUEUE="
-                    f"{self.max_queue})",
-                    retry_after_s=self.retry_after_s,
-                )
+        from fei_tpu.engine.tenancy import clamp_priority, sanitize_tenant
+
+        tenant = sanitize_tenant(
+            getattr(gen, "tenant", "") or self.tenants.default_tenant
+        )
+        priority = clamp_priority(getattr(gen, "priority", 0))
+        self._check_queue_caps(tenant, priority)
         n = len(prompt_ids)
         if n > eng.max_seq_len:
             raise EngineError(
@@ -380,8 +388,22 @@ class PagedScheduler(AdmissionMixin, DecodeMixin, ConstraintMixin):
             mask_fn=logit_mask_fn,
             stops=eng._stops(gen),
             budget=budget,
+            tenant=tenant,
+            priority=priority,
         )
         seq.t_queued = time.perf_counter()
+        with self._lock:
+            # a tenant going idle -> backlogged re-anchors its fair-share
+            # clock at the busy tenants' floor (tenancy.TenantBook)
+            busy = {
+                s.tenant
+                for s in list(self._waiting) + list(self._slots)
+                if s is not None and not s.finished
+            }
+            if tenant not in busy:
+                self.tenants.activate(
+                    tenant, (self.tenants.vtime(t) for t in busy)
+                )
         dl = getattr(gen, "deadline_s", 0.0) or self.default_deadline_s
         if dl > 0:
             seq.deadline = seq.t_queued + dl
@@ -419,7 +441,12 @@ class PagedScheduler(AdmissionMixin, DecodeMixin, ConstraintMixin):
                 # scheduler loop's token delivery
                 prebuilt = grammar.device_tables(eng.cfg.vocab_size)
             with self._lock:
-                if self._set_grammar(grammar, prebuilt):
+                # caps re-checked in the SAME critical section as the
+                # append: concurrent submits passed the _check_queue_caps
+                # pre-check against the same stale depth and would
+                # otherwise all append, overshooting the cap
+                victims, shed = self._caps_victims_locked(tenant, priority)
+                if shed is None and self._set_grammar(grammar, prebuilt):
                     seq.grammar = grammar
                     seq.gtrigger = grammar_trigger
                     if grammar_trigger is None:
@@ -438,6 +465,10 @@ class PagedScheduler(AdmissionMixin, DecodeMixin, ConstraintMixin):
                     self._waiting.append(seq)
                     self._start_thread()
                     appended = True
+                depth = len(self._waiting)
+            self._settle_caps(
+                victims, shed, tenant, priority, depth, arrival=seq
+            )
             if not appended:
                 # a different grammar is in flight: serve this request with
                 # the equivalent host mask rather than rejecting it
@@ -458,14 +489,130 @@ class PagedScheduler(AdmissionMixin, DecodeMixin, ConstraintMixin):
                     seq.gfallback_state = mstate
         if not appended:
             with self._lock:
-                self._closed = False  # a submit after close() reopens
-                self._waiting.append(seq)
-                self._start_thread()
+                # append-time cap enforcement (see the grammar branch):
+                # the early _check_queue_caps ran outside this lock and
+                # its verdict may be stale under concurrent submits
+                victims, shed = self._caps_victims_locked(tenant, priority)
+                if shed is None:
+                    self._closed = False  # a submit after close() reopens
+                    self._waiting.append(seq)
+                    self._start_thread()
+                depth = len(self._waiting)
+            self._settle_caps(
+                victims, shed, tenant, priority, depth, arrival=seq
+            )
         # full gauge refresh on submit (not just queue depth): /metrics
         # must reflect pool saturation even while nothing is finishing
         self._update_sched_gauges()
         self._wake.set()
         return seq
+
+    def _check_queue_caps(self, tenant: str, priority: int) -> None:
+        """Backpressure with shed ORDERING: when the global queue (or the
+        tenant's own FEI_TPU_TENANT_BUDGETS cap) is full, a strictly-
+        lower-priority queued request is evicted to make room — so the
+        429s land on the lowest priority class first — and only when no
+        such victim exists does the ARRIVAL shed with QueueFullError.
+
+        This pre-check fails a doomed arrival before the expensive work
+        (trace start, grammar tables); it is NOT the enforcement point —
+        submit() re-runs _caps_victims_locked in the same critical
+        section that appends to _waiting, so concurrent submits cannot
+        all pass a stale check and overshoot the cap."""
+        with self._lock:
+            victims, shed = self._caps_victims_locked(tenant, priority)
+            depth = len(self._waiting)
+        self._settle_caps(victims, shed, tenant, priority, depth)
+
+    def _caps_victims_locked(
+        self, tenant: str, priority: int
+    ) -> tuple[list[_Seq], str | None]:
+        """Queue-cap enforcement core; runs under self._lock. Removes any
+        displaced victims from _waiting and returns (victims,
+        shed_message_or_None) — the caller notifies victims and raises
+        OUTSIDE the lock via _settle_caps."""
+        victims: list[_Seq] = []
+        shed: str | None = None
+        pol = self.tenants.policy(tenant)
+        if not self.max_queue and not pol.queue_cap:
+            return victims, shed
+        if pol.queue_cap:
+            mine = [s for s in self._waiting if s.tenant == tenant]
+            if len(mine) >= pol.queue_cap:
+                v = self._queue_victim_locked(priority, within=mine)
+                if v is None:
+                    shed = (
+                        f"tenant {tenant!r} queue is full ({len(mine)} "
+                        f">= cap {pol.queue_cap})"
+                    )
+                else:
+                    self._waiting.remove(v)
+                    victims.append(v)
+        if (
+            shed is None and self.max_queue
+            and len(self._waiting) >= self.max_queue
+        ):
+            v = self._queue_victim_locked(priority)
+            if v is None:
+                shed = (
+                    f"waiting queue is full ({len(self._waiting)} >= "
+                    f"FEI_TPU_MAX_QUEUE={self.max_queue})"
+                )
+            else:
+                self._waiting.remove(v)
+                victims.append(v)
+        return victims, shed
+
+    def _settle_caps(
+        self, victims: list[_Seq], shed: str | None, tenant: str,
+        priority: int, depth: int, arrival: _Seq | None = None,
+    ) -> None:
+        """Deliver eviction errors to displaced victims and raise for a
+        shed arrival — the out-of-lock half of _caps_victims_locked.
+        ``arrival`` is the already-built _Seq of a shed arrival (the
+        append-time re-check), which must finish its trace as 'shed'."""
+        for v in victims:
+            v.finished = True
+            # _trace_finish counts scheduler.requests_shed: an evicted
+            # victim is a shed request like any backpressure rejection
+            self._trace_finish(v, "shed")
+            METRICS.incr(f"tenant.{v.tenant}.sheds")
+            FLIGHT.event(
+                "queue_evict", rid=v.rid, priority=v.priority,
+                by_priority=priority,
+            )
+            v.out.put(QueueFullError(
+                f"request {v.rid} (priority {v.priority}) was evicted from "
+                f"the full queue by a priority-{priority} arrival",
+                retry_after_s=self.retry_after_s,
+            ))
+        if shed is not None:
+            if arrival is not None:
+                # append-time shed: the arrival already has a trace, and
+                # _trace_finish counts scheduler.requests_shed for it
+                arrival.finished = True
+                self._trace_finish(arrival, "shed")
+            else:
+                # pre-check shed: no _Seq/trace exists yet
+                METRICS.incr("scheduler.requests_shed")
+            METRICS.incr(f"tenant.{tenant}.sheds")
+            METRICS.gauge("scheduler.queue_depth", depth)
+            raise QueueFullError(shed, retry_after_s=self.retry_after_s)
+
+    def _queue_victim_locked(
+        self, priority: int, within: list | None = None
+    ) -> _Seq | None:
+        """The queued request a higher-priority arrival may displace: the
+        lowest-priority, most-recently-queued one — and only from a class
+        STRICTLY below the arrival's (equals keep FIFO fairness)."""
+        pool = within if within is not None else self._waiting
+        best = None
+        for s in pool:  # later entries win ties -> newest of the class
+            if s.priority >= priority:
+                continue
+            if best is None or s.priority <= best.priority:
+                best = s
+        return best
 
     def degraded(self) -> bool:
         """True while the crash-loop breaker holds submits rejected; the
@@ -656,6 +803,10 @@ class PagedScheduler(AdmissionMixin, DecodeMixin, ConstraintMixin):
                 )
             seq.generated.append(t)
             seq.out.put(t)
+            # weighted-fair service accounting: admission picks the
+            # backlogged tenant with the least served-tokens/weight
+            self.tenants.charge(seq.tenant, 1)
+            METRICS.incr(f"tenant.{seq.tenant}.tokens_served")
         if not done and seq.gfallback_state is not None:
             # host-mask tool-call fallback: advance the masker NOW (it is
             # idempotent per prefix length) so acceptance ends the turn at
@@ -778,6 +929,19 @@ class PagedScheduler(AdmissionMixin, DecodeMixin, ConstraintMixin):
             METRICS.gauge("pool.pages_total", total)
             METRICS.gauge("pool.pages_free", free)
             METRICS.gauge("pool.pages_in_use", total - free)
+        if self.tenants.configured:
+            queued: dict[str, int] = {}
+            running: dict[str, int] = {}
+            for s in self._waiting:
+                queued[s.tenant] = queued.get(s.tenant, 0) + 1
+            for s in self._slots:
+                if s is not None and not s.finished:
+                    running[s.tenant] = running.get(s.tenant, 0) + 1
+            for t in set(queued) | set(running) | set(
+                k for k in self.tenants.policies if k != "*"
+            ):
+                METRICS.gauge(f"tenant.{t}.queued", queued.get(t, 0))
+                METRICS.gauge(f"tenant.{t}.running", running.get(t, 0))
 
     def _drain(self, exc: BaseException) -> None:
         """Fail every queued and in-flight request WITHOUT dropping device
@@ -856,23 +1020,30 @@ class PagedScheduler(AdmissionMixin, DecodeMixin, ConstraintMixin):
             return seq.prompt_ids + seq.generated[:-1]
         return seq.prompt_ids
 
-    def _pick_victim(self, exclude: _Seq | None) -> _Seq | None:
-        """min-progress policy: the running sequence least far toward its
-        budget loses (it has the least recompute to throw away and the
-        prefix cache makes its re-prefill cheap); ties go to the lowest
-        slot. The requester is excluded — a requester that must
-        self-preempt does so explicitly in the decode growth path.
-        Shielded slots (admitted but not yet through one decode
-        dispatch) are also skipped: preempting those livelocks
-        admissions against each other with zero tokens of progress."""
+    def _pick_victim(self, exclude: _Seq | None,
+                     max_priority: int | None = None) -> _Seq | None:
+        """Victim policy with priority classes: the LOWEST-priority
+        running sequence loses first; within a class, the one least far
+        toward its budget (it has the least recompute to throw away and
+        the prefix cache makes its re-prefill cheap); ties go to the
+        lowest slot. ``max_priority`` caps the eligible classes — pool-
+        pressure callers pass the requester's own priority so a request
+        can never evict someone more important to make room for itself.
+        The requester is excluded — a requester that must self-preempt
+        does so explicitly in the decode growth path. Shielded slots
+        (admitted but not yet through one decode dispatch) are also
+        skipped: preempting those livelocks admissions against each
+        other with zero tokens of progress."""
         best = None
-        best_p = None
+        best_k = None
         for s in self._slots:
             if s is None or s is exclude or s.finished or s.shield:
                 continue
-            p = len(s.generated) / max(s.budget, 1)
-            if best_p is None or p < best_p:
-                best, best_p = s, p
+            if max_priority is not None and s.priority > max_priority:
+                continue
+            k = (s.priority, len(s.generated) / max(s.budget, 1))
+            if best_k is None or k < best_k:
+                best, best_k = s, k
         return best
 
     def _preempt_seq(self, seq: _Seq, *, locked: bool,
@@ -902,6 +1073,7 @@ class PagedScheduler(AdmissionMixin, DecodeMixin, ConstraintMixin):
         if seq.trace is not None:
             seq.trace.event("preempted")
         METRICS.incr("scheduler.preemptions")
+        METRICS.incr(f"tenant.{seq.tenant}.preemptions")
         FLIGHT.event(
             "preempt", rid=seq.rid, slot=slot,
             generated=len(seq.generated), requeue=requeue,
@@ -945,7 +1117,7 @@ class PagedScheduler(AdmissionMixin, DecodeMixin, ConstraintMixin):
                 continue
             if not preempt or self.preempt_policy == "off":
                 return False
-            victim = self._pick_victim(exclude=seq)
+            victim = self._pick_victim(exclude=seq, max_priority=seq.priority)
             if victim is None:
                 return False
             self._preempt_seq(victim, locked=locked)

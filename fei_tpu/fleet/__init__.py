@@ -1,0 +1,17 @@
+"""Fleet front door: multi-replica serving above the single engine.
+
+``fei_tpu.fleet`` load-balances N serving replicas (in-process ServeAPI
+cores or remote HTTP endpoints) behind one OpenAI-compatible surface:
+least-loaded routing off /health capacity fields, session/prefix
+affinity so multi-turn conversations keep hitting their warm prefix
+cache, per-replica circuit breakers with half-open readmission, bounded
+retry that forwards the client's *remaining* deadline, and zero-downtime
+rolling restarts sequenced over the PR-4 drain → warm-restart ladder.
+
+See docs/FLEET.md for the operator story.
+"""
+
+from fei_tpu.fleet.replica import HttpReplica, InProcessReplica
+from fei_tpu.fleet.router import Router
+
+__all__ = ["HttpReplica", "InProcessReplica", "Router"]

@@ -1,0 +1,631 @@
+"""Fleet router: one front door over N serving replicas.
+
+Routing policy, in priority order:
+
+1. **Affinity** — a request carrying a session key (``X-FEI-Session``
+   header or ``body["session"]``), or failing that a hash of its first
+   message, prefers the replica that served that key last: multi-turn
+   conversations keep hitting their warm prefix cache. Affinity degrades
+   gracefully — a draining/ejected target falls back to least-loaded
+   (``router.affinity_misses``) instead of queueing behind a drain.
+2. **Least-loaded** — among usable replicas, the one with the lowest
+   ``(queue_depth + running) / slots`` read off its ``/health`` capacity
+   fields (TTL-cached, ``FEI_TPU_FLEET_HEALTH_TTL_S``).
+
+Failure handling:
+
+- **Circuit breaker** per replica: ``FEI_TPU_FLEET_BREAKER_FAILS``
+  consecutive transport failures eject it for
+  ``FEI_TPU_FLEET_BREAKER_COOLDOWN_S``; after the cooldown one
+  half-open health probe decides readmission vs re-ejection. 429/503
+  answers are backpressure, not failures — they divert the request but
+  never trip the breaker. A malformed request body is the CLIENT's
+  fault: it answers 400 (``router.invalid_requests``) without a retry
+  and without charging any replica's breaker — bad input must never
+  eject a healthy fleet.
+- **Bounded retry** (``FEI_TPU_FLEET_RETRIES``) with jittered backoff
+  (``FEI_TPU_FLEET_BACKOFF_S``), each attempt on a replica not yet
+  tried. Every forward carries ``X-FEI-Deadline-S`` = the client's
+  *remaining* deadline, so a retry can never grant a request more time
+  than it arrived with; an expired budget 504s in the router
+  (``router.deadline_expired``).
+- When *no* replica looks usable, the router force-probes the whole set
+  once before shedding 503 — a stale cache entry must not turn a
+  transient blip into an outage.
+
+``rolling_restart()`` sequences drain → warm-restart across the set one
+replica at a time, keeping the rest in rotation: zero accepted requests
+dropped (queued work snapshots and resumes; newly arriving work routes
+to the survivors).
+
+Fault points ``router.forward`` and ``replica.health`` make every path
+above chaos-testable (scripts/fleet_smoke.py sweeps them).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import random
+import threading
+import time
+from collections import OrderedDict
+from urllib.parse import urlsplit
+
+from fei_tpu.engine.faults import FAULTS
+from fei_tpu.obs.flight import FLIGHT
+from fei_tpu.utils.errors import EngineError
+from fei_tpu.utils.logging import get_logger
+from fei_tpu.utils.metrics import METRICS
+
+log = get_logger("fleet.router")
+
+_RETRYABLE = (429, 503)
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+class _ReplicaState:
+    """Router-side view of one replica (health cache + breaker)."""
+
+    __slots__ = ("fails", "ejected_until", "draining", "healthy",
+                 "queue_depth", "running", "slots", "last_probe")
+
+    def __init__(self):
+        self.fails = 0
+        self.ejected_until = 0.0   # monotonic deadline; 0 = not ejected
+        self.draining = False
+        self.healthy = True        # optimistic until the first probe
+        self.queue_depth = 0
+        self.running = 0
+        self.slots = 1
+        self.last_probe = 0.0      # monotonic; 0 = never probed
+
+    def load(self) -> float:
+        return (self.queue_depth + self.running) / max(self.slots, 1)
+
+
+class Router:
+    """ServeAPI-shaped front door (``handle`` / ``stream_chat``), so
+    ``ui.server.make_handler`` serves a router exactly like a single
+    replica. Thread-safe for concurrent submitters: per-replica state
+    updates are monotonic scalars; the affinity map takes the lock."""
+
+    def __init__(self, replicas, retries: int | None = None,
+                 backoff_s: float | None = None,
+                 breaker_fails: int | None = None,
+                 breaker_cooldown_s: float | None = None,
+                 affinity_cap: int | None = None,
+                 health_ttl_s: float | None = None):
+        if not replicas:
+            raise EngineError("Router needs at least one replica")
+        self.replicas = {r.rid: r for r in replicas}
+        if len(self.replicas) != len(replicas):
+            raise EngineError("replica ids must be unique")
+        self._order = [r.rid for r in replicas]
+        self._state = {rid: _ReplicaState() for rid in self._order}
+        self.retries = (
+            _env_int("FEI_TPU_FLEET_RETRIES", 2)
+            if retries is None else int(retries)
+        )
+        self.backoff_s = (
+            _env_float("FEI_TPU_FLEET_BACKOFF_S", 0.05)
+            if backoff_s is None else float(backoff_s)
+        )
+        self.breaker_fails = max(1, (
+            _env_int("FEI_TPU_FLEET_BREAKER_FAILS", 3)
+            if breaker_fails is None else int(breaker_fails)
+        ))
+        self.breaker_cooldown_s = (
+            _env_float("FEI_TPU_FLEET_BREAKER_COOLDOWN_S", 5.0)
+            if breaker_cooldown_s is None else float(breaker_cooldown_s)
+        )
+        self.affinity_cap = max(1, (
+            _env_int("FEI_TPU_FLEET_AFFINITY", 1024)
+            if affinity_cap is None else int(affinity_cap)
+        ))
+        self.health_ttl_s = (
+            _env_float("FEI_TPU_FLEET_HEALTH_TTL_S", 1.0)
+            if health_ttl_s is None else float(health_ttl_s)
+        )
+        self._affinity: OrderedDict[str, str] = OrderedDict()
+        self._lock = threading.Lock()
+
+    # -- health + breaker ---------------------------------------------------
+
+    def _probe(self, rid: str) -> bool:
+        """One health probe; updates the cached state. Transport failures
+        and degraded answers count toward the breaker; a draining answer
+        is orderly (out of rotation, no breaker pressure)."""
+        st = self._state[rid]
+        st.last_probe = time.monotonic()
+        try:
+            FAULTS.check("replica.health", replica=rid)
+            status, payload, _ = self.replicas[rid].request("GET", "/health")
+        except Exception as exc:  # noqa: BLE001 — any probe failure is
+            # a health failure; the breaker decides how many to forgive
+            log.debug("probe %s failed: %r", rid, exc)
+            st.healthy = False
+            self._note_failure(rid)
+            return False
+        payload = payload if isinstance(payload, dict) else {}
+        st.healthy = status == 200
+        st.draining = payload.get("status") == "draining"
+        st.queue_depth = int(payload.get("queue_depth") or 0)
+        st.running = int(payload.get("running") or 0)
+        st.slots = int(payload.get("slots") or 1)
+        if st.healthy:
+            # deliberately does NOT reset st.fails: a replica can answer
+            # /health while failing real forwards, and a passing probe
+            # must not erase the breaker's consecutive-failure count.
+            # Only a successful forward (or half-open readmission) does.
+            return True
+        if not st.draining:
+            self._note_failure(rid)
+        return False
+
+    def _note_failure(self, rid: str) -> None:
+        st = self._state[rid]
+        st.fails += 1
+        now = time.monotonic()
+        if st.fails >= self.breaker_fails:
+            if now >= st.ejected_until:
+                METRICS.incr("router.ejections")
+                FLIGHT.event("router_eject", replica=rid, fails=st.fails)
+                log.warning("breaker OPEN for replica %s after %d fails",
+                            rid, st.fails)
+            st.ejected_until = now + self.breaker_cooldown_s
+
+    def _usable(self, rid: str, force: bool = False) -> bool:
+        """Routable right now? Refreshes the health cache when stale and
+        runs the half-open probe when a breaker cooldown just expired."""
+        st = self._state[rid]
+        now = time.monotonic()
+        if st.ejected_until > now:
+            return False
+        half_open = st.ejected_until > 0.0  # cooldown expired, not cleared
+        if half_open or force or (now - st.last_probe) > self.health_ttl_s:
+            ok = self._probe(rid)
+            if half_open and ok:
+                st.ejected_until = 0.0
+                st.fails = 0
+                METRICS.incr("router.readmissions")
+                FLIGHT.event("router_readmit", replica=rid)
+                log.info("breaker CLOSED: replica %s readmitted", rid)
+            return ok and not st.draining
+        return st.healthy and not st.draining
+
+    def _candidates(self, force: bool = False,
+                    exclude=()) -> list[str]:
+        out = [rid for rid in self._order
+               if rid not in exclude and self._usable(rid, force=force)]
+        METRICS.gauge("router.replicas_usable", len(out))
+        return out
+
+    # -- routing ------------------------------------------------------------
+
+    @staticmethod
+    def _affinity_key(body: dict, headers: dict) -> str | None:
+        h = {str(k).lower(): v for k, v in (headers or {}).items()}
+        key = h.get("x-fei-session") or body.get("session")
+        if key:
+            return f"session:{key}"
+        msgs = body.get("messages")
+        for m in msgs if isinstance(msgs, list) else []:
+            if not isinstance(m, dict):
+                # malformed body: routing must never raise on client
+                # input — the replica's parse answers the 400
+                continue
+            c = m.get("content")
+            text = c if isinstance(c, str) else (
+                json.dumps(c, sort_keys=True) if c else ""
+            )
+            if text:
+                digest = hashlib.sha1(
+                    text[:256].encode("utf-8", "replace")
+                ).hexdigest()[:16]
+                return f"prefix:{digest}"
+        return None
+
+    def _pick(self, key: str | None, exclude=(),
+              force: bool = False) -> str | None:
+        cands = self._candidates(force=force, exclude=exclude)
+        if not cands:
+            return None
+        if key is not None:
+            with self._lock:
+                rid = self._affinity.get(key)
+            if rid is not None:
+                if rid in cands:
+                    METRICS.incr("router.affinity_hits")
+                    return rid
+                METRICS.incr("router.affinity_misses")
+        return min(cands, key=lambda r: self._state[r].load())
+
+    def _remember(self, key: str | None, rid: str) -> None:
+        if key is None:
+            return
+        with self._lock:
+            self._affinity[key] = rid
+            self._affinity.move_to_end(key)
+            while len(self._affinity) > self.affinity_cap:
+                self._affinity.popitem(last=False)
+
+    @staticmethod
+    def _deadline_budget(body: dict, headers: dict) -> float | None:
+        """The client's total deadline for this request (seconds), or
+        None. Folds body ``deadline_s`` with a propagated
+        ``X-FEI-Deadline-S`` so a chained router can only shrink it."""
+        h = {str(k).lower(): v for k, v in (headers or {}).items()}
+        vals = []
+        try:
+            dl = float(body.get("deadline_s") or 0)
+            if dl > 0:
+                vals.append(dl)
+        except (TypeError, ValueError):
+            pass
+        hd = h.get("x-fei-deadline-s")
+        if hd is not None:
+            try:
+                vals.append(max(1e-3, float(hd)))
+            except (TypeError, ValueError):
+                pass
+        return min(vals) if vals else None
+
+    def _backoff(self, attempt: int, remaining: float | None) -> None:
+        pause = random.uniform(0, self.backoff_s * (2 ** attempt))
+        if remaining is not None:
+            pause = min(pause, max(0.0, remaining))
+        if pause > 0:
+            time.sleep(pause)
+
+    # -- the front door -----------------------------------------------------
+
+    def handle(self, method: str, path: str, body: dict,
+               headers: dict) -> tuple:
+        """ServeAPI-shaped entry point: ``(status, payload[, headers])``."""
+        route = urlsplit(path).path
+        if route == "/health":
+            return self._health()
+        if route == "/fleet/status":
+            return 200, self._status_payload()
+        if route == "/v1/chat/completions" and method == "POST":
+            return self._forward(method, route, body, headers)
+        # any other route (models, metrics, traces, …) goes to one
+        # usable replica — no retry semantics to honor
+        rid = self._pick(None) or self._pick(None, force=True)
+        if rid is None:
+            METRICS.incr("router.sheds")
+            return 503, {"error": {"message": "no usable replica",
+                                   "type": "overloaded_error"}}, \
+                {"Retry-After": "1"}
+        try:
+            return self.replicas[rid].request(method, route, body, headers)
+        except Exception as exc:  # noqa: BLE001
+            self._state[rid].healthy = False
+            self._note_failure(rid)
+            return 502, {"error": {
+                "message": f"replica {rid}: {type(exc).__name__}: {exc}",
+                "type": "server_error"}}
+
+    def _health(self) -> tuple:
+        cands = self._candidates()
+        payload = {
+            "status": "ok" if cands else "unhealthy",
+            "replicas_usable": len(cands),
+            "replicas": self._status_payload()["replicas"],
+        }
+        if cands:
+            return 200, payload
+        return 503, payload, {"Retry-After": "1"}
+
+    def _status_payload(self) -> dict:
+        now = time.monotonic()
+        reps = {}
+        for rid in self._order:
+            st = self._state[rid]
+            reps[rid] = {
+                "healthy": st.healthy,
+                "draining": st.draining,
+                "ejected": st.ejected_until > now,
+                "consecutive_fails": st.fails,
+                "queue_depth": st.queue_depth,
+                "running": st.running,
+                "slots": st.slots,
+            }
+        return {"replicas": reps, "affinity_entries": len(self._affinity)}
+
+    def _forward(self, method: str, route: str, body: dict,
+                 headers: dict) -> tuple:
+        METRICS.incr("router.requests")
+        t0 = time.monotonic()
+        budget = self._deadline_budget(body, headers)
+        key = self._affinity_key(body, headers)
+        tried: set[str] = set()
+        last: tuple = (
+            503,
+            {"error": {"message": "no usable replica",
+                       "type": "overloaded_error"}},
+            {"Retry-After": "1"},
+        )
+        for attempt in range(self.retries + 1):
+            remaining = None
+            if budget is not None:
+                remaining = budget - (time.monotonic() - t0)
+                if remaining <= 0:
+                    METRICS.incr("router.deadline_expired")
+                    return 504, {"error": {
+                        "message": "deadline expired before a replica "
+                                   "answered",
+                        "type": "timeout_error"}}
+            rid = self._pick(key, exclude=tried)
+            if rid is None:
+                # force-probe the whole set once before giving up: a
+                # stale health cache must not shed a servable request
+                rid = self._pick(key, exclude=tried, force=True)
+            if rid is None:
+                break
+            fwd = dict(headers or {})
+            if remaining is not None:
+                fwd["X-FEI-Deadline-S"] = f"{remaining:.3f}"
+            st = self._state[rid]
+            try:
+                FAULTS.check("router.forward", replica=rid)
+                status, payload, extra = self.replicas[rid].request(
+                    method, route, body, fwd
+                )
+            except Exception as exc:  # noqa: BLE001
+                code = getattr(exc, "code", None)
+                tried.add(rid)
+                METRICS.incr("router.retries")
+                if code in _RETRYABLE:
+                    # injected/remote backpressure answer: divert, but
+                    # never charge the breaker
+                    last = (code, {"error": {
+                        "message": str(exc),
+                        "type": "overloaded_error"}}, {"Retry-After": "1"})
+                else:
+                    st.healthy = False
+                    self._note_failure(rid)
+                    last = (502, {"error": {
+                        "message": (
+                            f"replica {rid}: {type(exc).__name__}: {exc}"
+                        ),
+                        "type": "server_error"}}, {})
+                self._backoff(attempt, remaining)
+                continue
+            if status in _RETRYABLE:
+                tried.add(rid)
+                METRICS.incr("router.retries")
+                if (isinstance(payload, dict)
+                        and "draining" in str(payload).lower()):
+                    st.draining = True
+                last = (status, payload, dict(extra or {}))
+                self._backoff(attempt, remaining)
+                continue
+            st.fails = 0
+            if status == 200:
+                self._remember(key, rid)
+            return status, payload, dict(extra or {})
+        METRICS.incr("router.sheds")
+        status, payload, extra = last
+        extra = dict(extra or {})
+        extra.setdefault("Retry-After", "1")
+        return status, payload, extra
+
+    # -- streaming ----------------------------------------------------------
+
+    def stream_chat(self, body: dict, headers: dict | None = None):
+        """SSE frames, with replica failover only BEFORE the first
+        content frame — once tokens flowed, a failure is an error frame
+        (exactly the single-replica contract). Yields frames."""
+        METRICS.incr("router.requests")
+        headers = dict(headers or {})
+        t0 = time.monotonic()
+        budget = self._deadline_budget(body, headers)
+        key = self._affinity_key(body, headers)
+        tried: set[str] = set()
+        last_err = {"message": "no usable replica",
+                    "type": "overloaded_error"}
+        for attempt in range(self.retries + 1):
+            remaining = None
+            if budget is not None:
+                remaining = budget - (time.monotonic() - t0)
+                if remaining <= 0:
+                    METRICS.incr("router.deadline_expired")
+                    last_err = {"message": "deadline expired before a "
+                                           "replica answered",
+                                "type": "timeout_error"}
+                    break
+            rid = self._pick(key, exclude=tried)
+            if rid is None:
+                rid = self._pick(key, exclude=tried, force=True)
+            if rid is None:
+                break
+            fwd = dict(headers)
+            if remaining is not None:
+                fwd["X-FEI-Deadline-S"] = f"{remaining:.3f}"
+            try:
+                FAULTS.check("router.forward", replica=rid)
+                buffered, gen, err = self._try_stream(rid, body, fwd)
+            except (ValueError, KeyError, TypeError) as exc:
+                # malformed request body (ServeAPI._parse_request raises
+                # before any engine work): the CLIENT's fault, not the
+                # replica's — answer 400 without charging the breaker or
+                # retrying (the same body would fail on every replica)
+                METRICS.incr("router.invalid_requests")
+                yield (b"data: " + json.dumps({"error": {
+                    "message": str(exc),
+                    "type": "invalid_request_error"}}).encode() + b"\n\n")
+                yield b"data: [DONE]\n\n"
+                return
+            except Exception as exc:  # noqa: BLE001
+                code = getattr(exc, "code", None)
+                if code is not None and 400 <= code < 500 \
+                        and code not in _RETRYABLE:
+                    # a remote replica rejected the request itself
+                    # (HttpReplica.stream surfaces 4xx as HTTPError):
+                    # deterministic client error, same contract as above
+                    METRICS.incr("router.invalid_requests")
+                    yield (b"data: " + json.dumps({"error": {
+                        "message": str(exc),
+                        "type": "invalid_request_error"}}).encode()
+                        + b"\n\n")
+                    yield b"data: [DONE]\n\n"
+                    return
+                tried.add(rid)
+                METRICS.incr("router.retries")
+                if code in _RETRYABLE:
+                    last_err = {"message": str(exc),
+                                "type": "overloaded_error"}
+                else:
+                    self._state[rid].healthy = False
+                    self._note_failure(rid)
+                    last_err = {
+                        "message": (
+                            f"replica {rid}: {type(exc).__name__}: {exc}"
+                        ),
+                        "type": "server_error"}
+                self._backoff(attempt, remaining)
+                continue
+            if err is not None and err.get("type") == "overloaded_error":
+                # the replica shed before producing tokens: retryable
+                tried.add(rid)
+                METRICS.incr("router.retries")
+                last_err = err
+                self._backoff(attempt, remaining)
+                continue
+            self._state[rid].fails = 0
+            self._remember(key, rid)
+            yield from buffered
+            yield from gen
+            return
+        METRICS.incr("router.sheds")
+        yield (b"data: " + json.dumps({"error": last_err}).encode()
+               + b"\n\n")
+        yield b"data: [DONE]\n\n"
+
+    def _try_stream(self, rid: str, body: dict, headers: dict):
+        """Start a stream and pull frames until the replica committed
+        (first content/tool/finish frame) or declined (error frame
+        before any tokens). Returns (buffered_frames, generator,
+        error_dict_or_None)."""
+        gen = self.replicas[rid].stream(body, headers)
+        buffered = []
+        for chunk in gen:
+            buffered.append(chunk)
+            info = _parse_sse(chunk)
+            if info is None:  # [DONE] / non-JSON — nothing more to learn
+                return buffered, gen, None
+            err = info.get("error")
+            if err:
+                return buffered, gen, dict(err)
+            choice = (info.get("choices") or [{}])[0]
+            delta = choice.get("delta") or {}
+            if ("content" in delta or "tool_calls" in delta
+                    or choice.get("finish_reason")):
+                return buffered, gen, None
+            # role-only preamble frame: keep looking
+        return buffered, gen, None
+
+    # -- zero-downtime rolling restart --------------------------------------
+
+    def rolling_restart(self, drain_deadline_s: float | None = None,
+                        wait_s: float = 60.0) -> dict:
+        """Drain → warm-restart each replica in turn while the rest stay
+        in rotation. Zero accepted requests dropped: in-flight work
+        finishes or snapshots at drain and resumes after restart; new
+        arrivals route to the survivors. Returns a per-replica report.
+        Raises (before draining anything) if any replica cannot restart
+        in-place — a remote fleet restarts via its supervisor instead."""
+        # refuse BEFORE draining anything: a replica that cannot restart
+        # in-place (HttpReplica — its supervisor owns restarts) would
+        # otherwise be drained, stuck, and out of rotation forever while
+        # the sweep aborted mid-loop
+        stuck = [rid for rid in self._order
+                 if not getattr(self.replicas[rid], "can_restart", True)]
+        if stuck:
+            raise EngineError(
+                f"rolling restart refused: replica(s) {stuck} cannot "
+                "restart in-place (remote replicas restart via their "
+                "process supervisor); nothing was drained"
+            )
+        report = {}
+        for rid in list(self._order):
+            replica = self.replicas[rid]
+            st = self._state[rid]
+            st.draining = True  # out of rotation before the drain lands
+            FLIGHT.event("router_restart_begin", replica=rid)
+            drain_body = {}
+            if drain_deadline_s is not None:
+                drain_body["deadline_s"] = drain_deadline_s
+            try:
+                replica.request("POST", "/drain", drain_body)
+            except Exception as exc:  # noqa: BLE001 — an unreachable
+                # replica still gets restarted; that IS the remedy
+                log.warning("drain of %s failed: %r", rid, exc)
+            drained = replica.wait_drained(wait_s)
+            restart_err = None
+            try:
+                restored = replica.restart()
+            except Exception as exc:  # noqa: BLE001 — a failed restart
+                # must not abort the sweep with this replica stuck in
+                # draining=True: record it, let the probe loop rediscover
+                # the replica's true state, and keep going
+                log.warning("restart of %s failed: %r", rid, exc)
+                restart_err, restored = f"{type(exc).__name__}: {exc}", 0
+            # fresh process: clear breaker history, probe back in
+            st.fails = 0
+            st.ejected_until = 0.0
+            st.draining = False
+            deadline = time.monotonic() + wait_s
+            back = False
+            while time.monotonic() < deadline:
+                if self._probe(rid) and not st.draining:
+                    # boot probes that failed while the engine came up
+                    # charged the breaker; a healthy comeback must not
+                    # start its life ejected (mirror half-open readmit)
+                    st.fails = 0
+                    st.ejected_until = 0.0
+                    back = True
+                    break
+                time.sleep(0.05)
+            FLIGHT.event("router_restart_done", replica=rid,
+                         restored=restored)
+            report[rid] = {"drained": bool(drained),
+                           "restored": restored, "healthy": back}
+            if restart_err is not None:
+                report[rid]["error"] = restart_err
+            if not back:
+                log.warning("replica %s did not come back healthy after "
+                            "restart", rid)
+        METRICS.incr("router.rolling_restarts")
+        return report
+
+
+def _parse_sse(chunk: bytes) -> dict | None:
+    """One SSE frame -> its JSON payload, or None for [DONE]/non-JSON."""
+    raw = chunk.strip()
+    if not raw.startswith(b"data:"):
+        return None
+    raw = raw[len(b"data:"):].strip()
+    if raw == b"[DONE]":
+        return None
+    try:
+        out = json.loads(raw)
+        return out if isinstance(out, dict) else None
+    except ValueError:
+        return None

@@ -116,6 +116,53 @@ METRIC_REGISTRY: dict[str, tuple[str, str]] = {
                                 "On-demand jax.profiler captures taken."),
     "server.drains": ("counter", "Graceful drains initiated via POST "
                                  "/drain."),
+    "scheduler.priority_preemptions": (
+        "counter", "Running sequences preempted by a strictly higher-"
+                   "priority arrival when every slot was busy (the victim "
+                   "re-queues and resumes byte-identically)."),
+    "scheduler.tenant_budget_deferred": (
+        "counter", "Admissions deferred because the candidate tenant's "
+                   "reserved-token inflight would exceed its "
+                   "FEI_TPU_TENANT_BUDGETS token budget."),
+    "tenant.*.tokens_served": ("counter",
+                               "Tokens delivered to one tenant's "
+                               "requests (per-tenant family)."),
+    "tenant.*.sheds": ("counter",
+                       "Requests from one tenant rejected by "
+                       "backpressure or evicted from the full queue by a "
+                       "higher-priority arrival."),
+    "tenant.*.preemptions": ("counter",
+                             "Preemptions (pool-pressure or priority) "
+                             "charged to one tenant's sequences."),
+    "router.requests": ("counter", "Requests routed by the fleet router."),
+    "router.retries": ("counter",
+                       "Forward attempts retried on another replica "
+                       "(connection failures and 429/503 backpressure)."),
+    "router.ejections": ("counter",
+                         "Replicas ejected by the per-replica circuit "
+                         "breaker (consecutive-failure threshold)."),
+    "router.readmissions": ("counter",
+                            "Ejected replicas readmitted after a "
+                            "successful half-open health probe."),
+    "router.affinity_hits": ("counter",
+                             "Requests routed to their session/prefix "
+                             "affinity replica."),
+    "router.affinity_misses": ("counter",
+                               "Affinity lookups that fell back (replica "
+                               "draining, ejected, or unknown key)."),
+    "router.sheds": ("counter",
+                     "Requests the router shed with 503 after every "
+                     "replica was unusable or retries were exhausted."),
+    "router.invalid_requests": ("counter",
+                                "Malformed client requests answered 400 "
+                                "at the router without charging any "
+                                "replica's breaker."),
+    "router.deadline_expired": ("counter",
+                                "Requests that ran out of client deadline "
+                                "inside the router retry loop (504)."),
+    "router.rolling_restarts": ("counter",
+                                "Zero-downtime rolling restarts completed "
+                                "across the replica set."),
     "engine.compiles": ("counter",
                         "Jit program compilations observed (first build "
                         "per program signature — warmup cost)."),
@@ -164,6 +211,18 @@ METRIC_REGISTRY: dict[str, tuple[str, str]] = {
     "roofline.tok_s_per_chip": ("gauge",
                                 "Delivered tokens/s per chip over the most "
                                 "recent decode dispatch."),
+    "tenant.*.queued": ("gauge",
+                        "Sequences from one tenant waiting for admission "
+                        "(emitted only when tenant budgets are "
+                        "configured)."),
+    "tenant.*.running": ("gauge",
+                         "Sequences from one tenant actively decoding "
+                         "(emitted only when tenant budgets are "
+                         "configured)."),
+    "router.replicas_usable": ("gauge",
+                               "Replicas the fleet router considers "
+                               "routable (healthy, not draining, not "
+                               "ejected)."),
     # --- spans (each also feeds a <name>_seconds histogram) -------------
     "prefill": ("span", "Full prefill dispatch."),
     "prefill_chunk": ("span", "One chunked-prefill chunk."),

@@ -30,6 +30,10 @@ from dataclasses import dataclass, field
 
 TERMINAL_PHASES = (
     "completed", "cancelled", "failed", "deadline_exceeded", "snapshotted",
+    # queued request displaced by a higher-priority arrival when the
+    # bounded queue was full (scheduler._check_queue_caps) — counts into
+    # scheduler.requests_shed like every other backpressure rejection
+    "shed",
 )
 
 

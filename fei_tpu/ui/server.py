@@ -58,9 +58,15 @@ def _from_openai_messages(raw: list[dict]) -> tuple[list[dict], str | None]:
     Inverse of agent/providers.RemoteProvider._to_openai_messages: tool
     calls unwrap from type/function envelopes with JSON-string arguments;
     system turns lift into the provider's ``system`` parameter."""
+    if not isinstance(raw, list):
+        raise ValueError("messages must be a list of message objects")
     msgs: list[dict] = []
     system_parts: list[str] = []
     for m in raw:
+        if not isinstance(m, dict):
+            raise ValueError(
+                f"each message must be an object, got {type(m).__name__}"
+            )
         role = m.get("role", "user")
         if role == "system":
             system_parts.append(_content_text(m.get("content")))
@@ -104,10 +110,16 @@ def _from_openai_tools(raw: list[dict] | None) -> list[dict] | None:
     return out
 
 
-def _gen_overrides(body: dict) -> dict:
+def _gen_overrides(body: dict, headers: dict | None = None) -> dict:
     """Explicit JSON null means 'use the default' per the OpenAI spec
-    (several SDKs serialize unset fields as null)."""
+    (several SDKs serialize unset fields as null). ``headers`` carries
+    the fleet extensions: ``X-FEI-Tenant`` / ``X-FEI-Priority`` (QoS
+    labels, body fields win) and ``X-FEI-Deadline-S`` — the client's
+    REMAINING deadline as propagated by the fleet router, folded in as a
+    min() so a retry hop can only ever shrink the request's budget,
+    never extend it."""
     over: dict = {}
+    h = {str(k).lower(): v for k, v in (headers or {}).items()}
     if body.get("temperature") is not None:
         over["temperature"] = float(body["temperature"])
     if body.get("top_p") is not None:
@@ -118,8 +130,33 @@ def _gen_overrides(body: dict) -> dict:
         over["min_p"] = min(max(float(body["min_p"]), 0.0), 1.0)
     if body.get("seed") is not None:
         over["seed"] = int(body["seed"])
+    deadlines = []
     if body.get("deadline_s") is not None:  # non-OpenAI extension
-        over["deadline_s"] = max(0.0, float(body["deadline_s"]))
+        dl = max(0.0, float(body["deadline_s"]))
+        if dl > 0:
+            deadlines.append(dl)
+    hd = h.get("x-fei-deadline-s")
+    if hd is not None:
+        try:
+            # a propagated remaining budget of <= 0 means the client's
+            # deadline already passed in flight; clamp to an epsilon so
+            # the scheduler sheds it instead of treating 0 as "none"
+            deadlines.append(max(1e-3, float(hd)))
+        except (TypeError, ValueError):
+            pass
+    if deadlines:
+        over["deadline_s"] = min(deadlines)
+    tenant = body.get("tenant") or h.get("x-fei-tenant")
+    if tenant:  # non-OpenAI extension (multi-tenant QoS)
+        over["tenant"] = str(tenant)
+    priority = body.get("priority")
+    if priority is None:
+        priority = h.get("x-fei-priority")
+    if priority is not None:
+        try:
+            over["priority"] = int(priority)
+        except (TypeError, ValueError):
+            pass
     return over
 
 
@@ -200,18 +237,19 @@ class ServeAPI:
         METRICS.incr("server.requests")
         if route == "/health":
             mesh = self._mesh_tag()
+            load = self._load_fields()
             if self._draining():
                 # a draining replica must leave the load-balancer rotation
                 # while its in-flight set finishes
                 return 503, {"status": "draining", "model": self.model_name,
-                             "mesh": mesh}, {"Retry-After": "5"}
+                             "mesh": mesh, **load}, {"Retry-After": "5"}
             if self._degraded():
                 # surface the crash-loop breaker so load balancers eject
                 # the replica instead of feeding it doomed requests
                 return 503, {"status": "degraded", "model": self.model_name,
-                             "mesh": mesh}
+                             "mesh": mesh, **load}
             return 200, {"status": "ok", "model": self.model_name,
-                         "mesh": mesh}
+                         "mesh": mesh, **load}
         if route == "/metrics" and method == "GET":
             # pre-auth like /health: scrapers don't carry bearer tokens
             return 200, METRICS.prometheus_text()
@@ -248,7 +286,7 @@ class ServeAPI:
             # Chrome-trace / Perfetto JSON of the engine flight recorder
             return 200, FLIGHT.chrome_trace()
         if route == "/v1/chat/completions" and method == "POST":
-            return self._chat(body)
+            return self._chat(body, headers)
         if route == "/drain" and method == "POST":
             return self._drain(body)
         if route == "/debug/profile" and method == "POST":
@@ -295,7 +333,8 @@ class ServeAPI:
         finally:
             self._profile_lock.release()
 
-    def _parse_request(self, body: dict) -> dict:
+    def _parse_request(self, body: dict,
+                       headers: dict | None = None) -> dict:
         """Decode the request into provider kwargs; raises on bad input
         BEFORE any engine work (the streaming path validates here before
         committing SSE headers)."""
@@ -311,7 +350,7 @@ class ServeAPI:
             "system": system,
             "tools": _from_openai_tools(body.get("tools")),
             "max_tokens": mt,
-            **self._overrides_kw(body),
+            **self._overrides_kw(body, headers),
         }
 
     def _mesh_tag(self) -> str:
@@ -336,6 +375,26 @@ class ServeAPI:
         eng = getattr(self.provider, "engine", None)
         sched = getattr(eng, "_scheduler", None)
         return sched is not None and sched.draining()
+
+    def _load_fields(self) -> dict:
+        """Additive /health load fields the fleet router's least-loaded
+        scoring reads: waiting-queue depth, running count, slot count.
+        Empty for non-engine providers (router treats missing as 0)."""
+        eng = getattr(self.provider, "engine", None)
+        sched = getattr(eng, "_scheduler", None)
+        if sched is None:
+            return {}
+        try:
+            with sched._lock:
+                slots = list(sched._slots)
+                depth = len(sched._waiting)
+            running = sum(
+                1 for s in slots if s is not None and not s.finished
+            )
+            return {"queue_depth": depth, "running": running,
+                    "slots": len(slots)}
+        except Exception:  # noqa: BLE001 — /health must never 500
+            return {}
 
     def _drain(self, body: dict) -> tuple:
         """Operator-initiated graceful drain — the HTTP twin of SIGTERM:
@@ -366,9 +425,9 @@ class ServeAPI:
             getattr(exc, "retry_after_s", 1.0)
         )))}
 
-    def _chat(self, body: dict) -> tuple:
+    def _chat(self, body: dict, headers: dict | None = None) -> tuple:
         try:
-            kw = self._parse_request(body)
+            kw = self._parse_request(body, headers)
         except (ValueError, KeyError, TypeError) as exc:
             return 400, {"error": {"message": str(exc),
                                    "type": "invalid_request_error"}}
@@ -398,11 +457,11 @@ class ServeAPI:
             resp, body.get("model") or self.model_name, rid
         )
 
-    def _overrides_kw(self, body: dict) -> dict:
+    def _overrides_kw(self, body: dict, headers: dict | None = None) -> dict:
         """Per-request sampling knobs — only for providers that declare
         support (JaxLocalProvider); remote/mock providers ignore sampling
         anyway."""
-        over = _gen_overrides(body)
+        over = _gen_overrides(body, headers)
         if over and getattr(self.provider, "supports_gen_overrides", False):
             return {"gen_overrides": over}
         return {}
@@ -503,14 +562,16 @@ def make_handler(api: ServeAPI):
             self.wfile.write(data)
 
         def _body(self) -> dict | None:
-            """None means malformed JSON (-> 400), {} means no body."""
+            """None means malformed JSON or a non-object body (-> 400),
+            {} means no body."""
             n = int(self.headers.get("Content-Length") or 0)
             if not n:
                 return {}
             try:
-                return json.loads(self.rfile.read(n))
+                data = json.loads(self.rfile.read(n))
             except json.JSONDecodeError:
                 return None
+            return data if isinstance(data, dict) else None
 
         def do_GET(self):  # noqa: N802
             res = api.handle("GET", self.path, {}, dict(self.headers))
@@ -520,7 +581,7 @@ def make_handler(api: ServeAPI):
             body = self._body()
             if body is None:
                 self._json(400, {"error": {
-                    "message": "request body is not valid JSON",
+                    "message": "request body is not a JSON object",
                     "type": "invalid_request_error"}})
                 return
             if (
@@ -531,7 +592,7 @@ def make_handler(api: ServeAPI):
                 # validate BEFORE committing 200 + SSE headers, so a bad
                 # request gets a clean JSON 400 like the non-stream path
                 try:
-                    kw = api._parse_request(body)
+                    kw = api._parse_request(body, dict(self.headers))
                 except (ValueError, KeyError, TypeError) as exc:
                     self._json(400, {"error": {"message": str(exc),
                                                "type": "invalid_request_error"}})

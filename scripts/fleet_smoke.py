@@ -1,0 +1,232 @@
+#!/usr/bin/env python
+"""CI fleet smoke: the multi-replica front door end-to-end.
+
+Drives fei_tpu.fleet.Router over TWO in-process tiny replicas (real paged
+engines behind socket-free ServeAPI cores) and proves the PR's robustness
+claims on CPU, no ports, no subprocesses:
+
+1. mixed-tenant threaded load lands entirely — every request reaches 200
+   within a bounded number of client-side backpressure retries (429/503
+   are the protocol, not losses);
+2. breaker round-trip — a replica-scoped injected connection fault
+   (``router.forward``, match r0) trips the circuit breaker, the fleet
+   keeps serving through r1, and after the cooldown a half-open health
+   probe READMITS r0 (``router.ejections`` and ``router.readmissions``
+   both move);
+3. zero-downtime rolling restart — drain → warm-restart sequenced across
+   both replicas while streaming load keeps flowing; zero streams that
+   had tokens flowing die mid-stream, and every request still completes.
+
+The rehearse/on-chip pipelines also re-run this file with FEI_TPU_FAULT
+sweeping ``router.forward:{conn,http503,hang}`` and ``replica.health:
+conn`` — the retry/breaker/force-reprobe paths must absorb each kind
+with no assertion weakened (the env-armed counts are below the breaker
+threshold times the replica count).
+
+Exit status: 0 clean, non-zero with a reason on stderr.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def fail(msg: str) -> int:
+    print(f"fleet smoke: FAIL: {msg}", file=sys.stderr)
+    return 1
+
+
+def main() -> int:
+    import os
+    import tempfile
+
+    # QoS env must land before any engine builds its TenantBook
+    os.environ.setdefault("FEI_TPU_TENANT_BUDGETS",
+                          "gold:4,silver:2,bronze:1")
+    os.environ.setdefault("FEI_TPU_MAX_QUEUE", "4")
+
+    from fei_tpu.agent.providers import JaxLocalProvider
+    from fei_tpu.engine.engine import InferenceEngine
+    from fei_tpu.engine.faults import FAULTS
+    from fei_tpu.fleet import InProcessReplica, Router
+    from fei_tpu.ui.server import ServeAPI
+    from fei_tpu.utils.metrics import METRICS
+
+    def factory():
+        engine = InferenceEngine.from_config(
+            "tiny", paged=True, batch_size=2, page_size=16, max_seq_len=256,
+        )
+        return ServeAPI(JaxLocalProvider(engine=engine), model_name="fleet")
+
+    replicas = [
+        InProcessReplica(
+            f"r{i}", factory=factory,
+            drain_dir=tempfile.mkdtemp(prefix=f"fei-fleet-smoke-r{i}-"),
+        )
+        for i in range(2)
+    ]
+    router = Router(
+        replicas, retries=2, backoff_s=0.02, breaker_fails=3,
+        breaker_cooldown_s=0.4, health_ttl_s=0.1,
+    )
+
+    tenants = [("gold", 2), ("silver", 1), ("bronze", 0)]
+
+    def complete(i: int, tenant: str, priority: int,
+                 max_attempts: int = 40) -> tuple[bool, str]:
+        """One request, retrying client-side on backpressure (the 429/503
+        contract). True when it reached 200."""
+        body = {
+            "messages": [{"role": "user",
+                          "content": f"smoke {tenant} {i}"}],
+            "max_tokens": 4, "temperature": 0,
+            "tenant": tenant, "priority": priority,
+            "session": f"{tenant}-{i}",
+        }
+        last = "no attempt"
+        for _ in range(max_attempts):
+            res = router.handle("POST", "/v1/chat/completions", body, {})
+            status, payload = res[0], res[1]
+            if status == 200:
+                return True, "ok"
+            last = f"{status}: {payload}"
+            time.sleep(0.05)
+        return False, last
+
+    # --- 1. mixed-tenant load: zero accepted-request loss ------------------
+    n = 9
+    outcomes: list = [None] * n
+
+    def worker(i: int) -> None:
+        tenant, priority = tenants[i % len(tenants)]
+        outcomes[i] = complete(i, tenant, priority)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(n)]
+    [t.start() for t in threads]
+    [t.join(timeout=300) for t in threads]
+    bad = [(i, o) for i, o in enumerate(outcomes) if not (o and o[0])]
+    if bad:
+        return fail(f"mixed-tenant load lost requests: {bad}")
+    print(f"fleet smoke: load ok — {n} mixed-tenant requests all reached 200")
+
+    # --- 2. breaker eject -> half-open readmit round-trip ------------------
+    c0 = METRICS.snapshot()["counters"]
+    # fired() is cumulative; an env-armed chaos fault may already have
+    # consumed fires at this point during phase 1
+    fired0 = FAULTS.fired("router.forward")
+    FAULTS.arm("router.forward", "conn", count=3,
+               match=lambda ctx: ctx.get("replica") == "r0")
+    # pace requests past the health-probe TTL so r0 re-enters rotation
+    # between failures and the armed count actually drains to the
+    # breaker threshold; every request must still land via r1
+    deadline = time.time() + 15.0
+    i = 0
+    while (FAULTS.fired("router.forward") - fired0 < 3
+           and time.time() < deadline):
+        ok, why = complete(100 + i, "gold", 2)
+        if not ok:
+            return fail(f"request lost during breaker trip: {why}")
+        i += 1
+        time.sleep(0.12)
+    c1 = METRICS.snapshot()["counters"]
+    ejections = c1.get("router.ejections", 0) - c0.get("router.ejections", 0)
+    if ejections < 1:
+        return fail(
+            f"breaker never opened (fired={FAULTS.fired('router.forward')}, "
+            f"state={router._status_payload()})"
+        )
+    deadline = time.time() + 10.0
+    readmitted = False
+    while time.time() < deadline:
+        router._candidates()  # half-open probe runs once the cooldown ends
+        c2 = METRICS.snapshot()["counters"]
+        if c2.get("router.readmissions", 0) > c0.get("router.readmissions", 0):
+            readmitted = True
+            break
+        time.sleep(0.1)
+    if not readmitted:
+        return fail(f"r0 never readmitted: {router._status_payload()}")
+    ok, why = complete(199, "gold", 2)
+    if not ok:
+        return fail(f"request lost after readmission: {why}")
+    print("fleet smoke: breaker ok — r0 ejected then readmitted "
+          f"(+{ejections} ejections)")
+
+    # --- 3. rolling restart under streaming load: zero drops ---------------
+    from fei_tpu.fleet.router import _parse_sse
+
+    results: list = []
+    res_lock = threading.Lock()
+    stop = threading.Event()
+
+    def stream_worker(idx: int) -> None:
+        tenant, priority = tenants[idx % len(tenants)]
+        r = 0
+        while not stop.is_set():
+            body = {
+                "messages": [{"role": "user",
+                              "content": f"restart {tenant} {idx} {r}"}],
+                "max_tokens": 4, "temperature": 0,
+                "tenant": tenant, "priority": priority,
+            }
+            tokens, err = 0, None
+            for chunk in router.stream_chat(body, {}):
+                info = _parse_sse(chunk)
+                if info is None:
+                    continue
+                if info.get("error"):
+                    err = info["error"]
+                    break
+                delta = (info.get("choices") or [{}])[0].get("delta") or {}
+                if delta.get("content"):
+                    tokens += 1
+            with res_lock:
+                results.append((tokens, err))
+            r += 1
+            time.sleep(0.02)
+
+    workers = [threading.Thread(target=stream_worker, args=(i,))
+               for i in range(4)]
+    [w.start() for w in workers]
+    time.sleep(0.5)
+    report = router.rolling_restart(drain_deadline_s=60.0, wait_s=120.0)
+    time.sleep(0.5)
+    stop.set()
+    [w.join(timeout=300) for w in workers]
+    if not all(v.get("healthy") for v in report.values()):
+        return fail(f"a replica did not come back healthy: {report}")
+    dropped = [r for r in results if r[0] > 0 and r[1] is not None]
+    if dropped:
+        return fail(
+            f"{len(dropped)} accepted stream(s) dropped mid-restart: "
+            f"{dropped[:3]}"
+        )
+    served = sum(1 for r in results if r[1] is None and r[0] > 0)
+    if served == 0:
+        return fail(f"no stream served during the restart window: {results}")
+    restored = sum(v.get("restored", 0) for v in report.values())
+    print(
+        f"fleet smoke: restart ok — {served} streams served, "
+        f"0 accepted drops, {restored} snapshot(s) warm-restored, "
+        f"report={report}"
+    )
+
+    c = METRICS.snapshot()["counters"]
+    print(
+        "fleet smoke: OK — requests="
+        f"{int(c.get('router.requests', 0))} "
+        f"retries={int(c.get('router.retries', 0))} "
+        f"ejections={int(c.get('router.ejections', 0))} "
+        f"readmissions={int(c.get('router.readmissions', 0))} "
+        f"restarts={int(c.get('router.rolling_restarts', 0))}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

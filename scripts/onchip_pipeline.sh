@@ -135,6 +135,27 @@ stage preemption env FEI_TPU_TEST_PLATFORM=tpu python -m pytest \
 stage drain_restart env FEI_TPU_TEST_PLATFORM=tpu python -m pytest \
   tests/test_preemption.py::TestDrainRestart -q --timeout 600
 
+# 0d1b. fleet front door ON-CHIP: two in-process replicas (real device
+# engines) behind the router — mixed-tenant load with zero accepted
+# loss, breaker eject/readmit, zero-downtime rolling restart — plus one
+# chaos re-run per router fault point, the QoS/router test files, and
+# the multi-tenant overload bench at a wider burst (docs/FLEET.md)
+stage fleet_smoke python -u scripts/fleet_smoke.py
+stage chaos_router_conn env FEI_TPU_FAULT="router.forward:conn:2" \
+  python -u scripts/fleet_smoke.py
+stage chaos_router_503 env FEI_TPU_FAULT="router.forward:http503:2" \
+  python -u scripts/fleet_smoke.py
+stage chaos_router_hang env FEI_TPU_FAULT="router.forward:hang:2" \
+  python -u scripts/fleet_smoke.py
+stage chaos_replica_health env FEI_TPU_FAULT="replica.health:conn:2" \
+  python -u scripts/fleet_smoke.py
+stage tenancy_tests env FEI_TPU_TEST_PLATFORM=tpu python -m pytest \
+  tests/test_tenancy.py -q --timeout 600
+stage fleet_tests env FEI_TPU_TEST_PLATFORM=tpu python -m pytest \
+  tests/test_fleet.py -q --timeout 600
+stage bench_fleet env FEI_TPU_BENCH_SUITE=fleet FEI_TPU_BENCH_SESSIONS=24 \
+  FEI_TPU_BENCH_MAX_WAIT_S=300 python -u bench.py
+
 # 0d2. flight-recorder timeline smoke ON-CHIP: mixed workload (concurrent
 # admissions, turbo decode, organic preemption) against real device
 # dispatches, then /debug/timeline must return valid Chrome-trace JSON

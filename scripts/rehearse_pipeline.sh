@@ -101,6 +101,7 @@ stage ab_spec_on --json -- env FEI_TPU_BENCH_SUITE=paged \
   FEI_TPU_BENCH_STREAMS=1 FEI_TPU_SPECULATE=1 python -u bench.py
 
 # --- round-5 follow-up stages (scripts/onchip_extra.sh) -------------------
+stage chunk64 --json -- env FEI_TPU_BENCH_CHUNK=64 python -u bench.py
 stage chunk128 --json -- env FEI_TPU_BENCH_CHUNK=128 python -u bench.py
 stage chunk256 --json -- env FEI_TPU_BENCH_CHUNK=256 python -u bench.py
 stage bench_phi2_int4 --json -- env FEI_TPU_BENCH_MODEL=tiny-phi \
@@ -179,6 +180,25 @@ stage drain_restart -- python -m pytest \
 # must return valid Chrome-trace JSON with per-dispatch issue/sync spans
 # tagged rid + mesh (docs/OBSERVABILITY.md "Flight recorder") ----
 stage timeline -- python -u scripts/timeline_smoke.py
+
+# --- fleet front door: two in-process replicas behind the router —
+# mixed-tenant load with zero accepted loss, breaker eject/readmit
+# round-trip, zero-downtime rolling restart — then the same proof with
+# chaos armed at each router fault point/kind, the multi-tenant QoS +
+# router test files, and the overload bench (docs/FLEET.md) ----
+stage fleet_smoke -- python -u scripts/fleet_smoke.py
+stage chaos_router_conn -- env FEI_TPU_FAULT="router.forward:conn:2" \
+  python -u scripts/fleet_smoke.py
+stage chaos_router_503 -- env FEI_TPU_FAULT="router.forward:http503:2" \
+  python -u scripts/fleet_smoke.py
+stage chaos_router_hang -- env FEI_TPU_FAULT="router.forward:hang:2" \
+  python -u scripts/fleet_smoke.py
+stage chaos_replica_health -- env FEI_TPU_FAULT="replica.health:conn:2" \
+  python -u scripts/fleet_smoke.py
+stage tenancy_tests -- python -m pytest tests/test_tenancy.py -q --timeout 600
+stage fleet_tests -- python -m pytest tests/test_fleet.py -q --timeout 600
+stage bench_fleet --json -- env FEI_TPU_BENCH_SUITE=fleet \
+  FEI_TPU_BENCH_SESSIONS=9 FEI_TPU_BENCH_ROUNDS=1 python -u bench.py
 
 echo
 echo "=== rehearsal results ==="

@@ -23,6 +23,24 @@ import jax  # noqa: E402
 if _platform == "cpu":
     jax.config.update("jax_platforms", "cpu")
 
+# Persistent XLA compilation cache: the suite builds dozens of tiny
+# engines whose programs recompile identically run after run; caching
+# them cuts hundreds of seconds of wall time on repeat runs (first run
+# populates, later runs hit). Opt out with FEI_TPU_TEST_COMPILE_CACHE=0
+# or point it at a different directory.
+_cache_dir = os.environ.get(
+    "FEI_TPU_TEST_COMPILE_CACHE",
+    os.path.expanduser("~/.cache/fei_tpu_test_xla"),
+)
+if _cache_dir not in ("0", ""):
+    try:
+        jax.config.update("jax_compilation_cache_dir", _cache_dir)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        jax.config.update("jax_persistent_cache_enable_xla_caches", "all")
+    except Exception:  # noqa: BLE001 — older jax: knobs absent, cache off
+        pass
+
 import pytest  # noqa: E402
 
 

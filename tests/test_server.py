@@ -143,6 +143,36 @@ class TestProtocolShape:
             urllib.request.urlopen(req, timeout=10)
         assert e.value.code == 400
 
+    def test_non_object_body_is_400(self, mock_server):
+        """json.loads accepts bare strings/lists — the handler must 400
+        them instead of crashing on body.get()."""
+        server, _ = mock_server
+        for raw in (b'"just a string"', b'[1, 2, 3]', b'42'):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{server.port}/v1/chat/completions",
+                data=raw,
+                headers={"Content-Type": "application/json"}, method="POST",
+            )
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(req, timeout=10)
+            assert e.value.code == 400, raw
+            payload = json.loads(e.value.read())
+            assert payload["error"]["type"] == "invalid_request_error"
+
+    def test_malformed_messages_shape_is_400(self, mock_server):
+        """Non-list messages / non-dict entries must raise ValueError in
+        _parse_request (-> 400), never AttributeError (-> 500) — the
+        fleet router relies on the error class to tell a client error
+        from a replica failure."""
+        server, _ = mock_server
+        for bad in ("not-a-list", [7], [None],
+                    [{"role": "user", "content": "x"}, "trailer"]):
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _post(server.port, "/v1/chat/completions", {"messages": bad})
+            assert e.value.code == 400, bad
+            payload = json.loads(e.value.read())
+            assert payload["error"]["type"] == "invalid_request_error"
+
     def test_bad_stream_request_is_400_not_dropped(self, mock_server):
         server, _ = mock_server
         with pytest.raises(urllib.error.HTTPError) as e:

@@ -1,0 +1,440 @@
+"""Multi-tenant QoS: weighted-fair admission, priority shed ordering,
+and priority slot preemption (docs/FLEET.md "Multi-tenant QoS").
+
+The claims under test:
+- requests carry ``tenant`` + ``priority`` (GenerationConfig fields fed
+  from the body or the X-FEI-* headers); labels sanitize to a metric-
+  safe alphabet and priorities clamp to small ordinal classes;
+- with no FEI_TPU_TENANT_BUDGETS table and uniform priorities the
+  admission order is EXACTLY the legacy FIFO head (byte-identity and
+  starvation guarantees unchanged);
+- with a policy table, admission is start-time weighted fair queueing
+  over served tokens: two always-backlogged tenants at weights 3:1 are
+  admitted within 10% of 3:1; priority classes admit strictly first;
+  a tenant's token budget defers its admissions while its running
+  sequences hold the budget;
+- backpressure sheds in priority order: a full queue evicts the
+  lowest-priority newest-queued request STRICTLY below the arrival
+  (equals keep FIFO fairness), so 429s land on priority 0 first;
+- a high-priority arrival with no free slot preempts a strictly
+  lower-priority running victim through the snapshot/resume ladder and
+  the victim's stream is BYTE-IDENTICAL to an unpreempted run — greedy
+  and seeded.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from fei_tpu.engine.engine import GenerationConfig, InferenceEngine
+from fei_tpu.engine.tenancy import (
+    TenantBook,
+    TenantPolicy,
+    clamp_priority,
+    parse_tenant_budgets,
+    sanitize_tenant,
+)
+from fei_tpu.utils.errors import QueueFullError
+from fei_tpu.utils.metrics import METRICS
+
+PROMPT = list(range(11, 29))
+
+
+def _counter(name: str) -> float:
+    return METRICS.snapshot()["counters"].get(name, 0)
+
+
+def _gen(**kw) -> GenerationConfig:
+    kw.setdefault("max_new_tokens", 16)
+    kw.setdefault("temperature", 0.0)
+    kw.setdefault("ignore_eos", True)
+    return GenerationConfig(**kw)
+
+
+def _make(**kwargs) -> InferenceEngine:
+    return InferenceEngine.from_config(
+        "tiny", paged=True, batch_size=kwargs.pop("batch_size", 2), **kwargs
+    )
+
+
+def _parked(**kwargs):
+    """An engine whose scheduler thread never starts: submits park in
+    the waiting queue so admission order and shed ordering are
+    observable as pure data-structure facts (no decode, no sleeps)."""
+    eng = _make(**kwargs)
+    sched = eng.scheduler
+    sched._start_thread = lambda: None
+    return sched
+
+
+class TestPolicyParse:
+    def test_full_spec(self):
+        t = parse_tenant_budgets("gold:4,silver:2:8,bronze:1:4:4096,*:1")
+        assert t["gold"] == TenantPolicy("gold", 4.0, 0, 0)
+        assert t["silver"] == TenantPolicy("silver", 2.0, 8, 0)
+        assert t["bronze"] == TenantPolicy("bronze", 1.0, 4, 4096)
+        assert t["*"].weight == 1.0
+
+    def test_malformed_entries_skip_not_raise(self):
+        t = parse_tenant_budgets("gold:nope,:3,silver:2,,x:1:bad")
+        assert set(t) == {"silver"}
+
+    def test_non_positive_weight_coerces_to_one(self):
+        assert parse_tenant_budgets("a:0")["a"].weight == 1.0
+        assert parse_tenant_budgets("a:-3")["a"].weight == 1.0
+
+    def test_names_sanitize(self):
+        t = parse_tenant_budgets("team a/b:2")
+        assert "team_a_b" in t
+        assert sanitize_tenant("  spaced out!  ") == "spaced_out_"
+        assert sanitize_tenant("") == "default"
+        assert len(sanitize_tenant("x" * 200)) == 64
+
+    def test_priority_clamps(self):
+        assert clamp_priority(999999) == 9
+        assert clamp_priority(-4) == 0
+        assert clamp_priority("3") == 3
+        assert clamp_priority("soon") == 0
+        assert clamp_priority(None) == 0
+
+
+class TestTenantBook:
+    def test_unconfigured_book_is_fast_path_eligible(self):
+        book = TenantBook(policies={})
+        assert not book.configured
+        assert book.policy("anyone").weight == 1.0
+
+    def test_charge_scales_inverse_to_weight(self):
+        book = TenantBook(policies=parse_tenant_budgets("gold:4,bronze:1"))
+        book.charge("gold", 8)
+        book.charge("bronze", 8)
+        assert book.vtime("gold") == pytest.approx(2.0)
+        assert book.vtime("bronze") == pytest.approx(8.0)
+
+    def test_activate_reanchors_at_busy_floor(self):
+        book = TenantBook(policies=parse_tenant_budgets("a:1,b:1"))
+        book.charge("a", 100)
+        book.charge("b", 120)
+        # c was idle the whole time: it competes from the floor, not
+        # from vtime 0 (which would starve a and b while c catches up)
+        book.activate("c", [book.vtime("a"), book.vtime("b")])
+        assert book.vtime("c") == pytest.approx(100.0)
+        # re-activating a busy tenant never moves it backwards
+        book.activate("b", [book.vtime("a")])
+        assert book.vtime("b") == pytest.approx(120.0)
+
+
+class TestAdmissionOrder:
+    def test_uniform_no_budgets_is_exact_legacy_fifo(self):
+        sched = _parked()
+        seqs = [sched.submit(PROMPT, _gen()) for _ in range(4)]
+        assert not sched.tenants.configured
+        assert sched._next_admission_locked() is seqs[0]
+
+    def test_higher_priority_class_admits_first(self):
+        sched = _parked()
+        sched.submit(PROMPT, _gen(priority=0))
+        hi = sched.submit(PROMPT, _gen(priority=2))
+        sched.submit(PROMPT, _gen(priority=1))
+        assert sched._next_admission_locked() is hi
+
+    def test_wfq_three_to_one_within_ten_percent(self):
+        """The fairness pin: both tenants permanently backlogged, each
+        admission charged the same service — admission counts land
+        within 10% of the configured 3:1 weights."""
+        sched = _parked()
+        book = TenantBook(policies=parse_tenant_budgets("gold:3,bronze:1"))
+        sched.tenants = book
+        for i in range(40):
+            sched.submit(PROMPT, _gen(tenant="gold"))
+            sched.submit(PROMPT, _gen(tenant="bronze"))
+        served = {"gold": 0, "bronze": 0}
+        for _ in range(40):
+            pick = sched._next_admission_locked()
+            assert pick is not None
+            sched._waiting.remove(pick)
+            served[pick.tenant] += 1
+            book.charge(pick.tenant, 8)  # same tokens per admission
+        share = served["gold"] / 40
+        assert abs(share - 0.75) <= 0.075, served
+
+    def test_token_budget_defers_tenant(self):
+        sched = _parked()
+        sched.tenants = TenantBook(
+            policies=parse_tenant_budgets("capped:1:0:64,free:1")
+        )
+        d0 = _counter("scheduler.tenant_budget_deferred")
+        # a running sequence holding capped's whole budget
+        running = sched.submit(PROMPT, _gen(tenant="capped", max_new_tokens=46))
+        sched._waiting.remove(running)
+        sched._slots[0] = running
+        queued_capped = sched.submit(PROMPT, _gen(tenant="capped"))
+        queued_free = sched.submit(PROMPT, _gen(tenant="free"))
+        assert sched._next_admission_locked() is queued_free
+        assert _counter("scheduler.tenant_budget_deferred") > d0
+        # with nothing of capped's running it always gets a floor of one
+        sched._slots[0] = None
+        sched._waiting.remove(queued_free)
+        assert sched._next_admission_locked() is queued_capped
+
+    def test_budget_deferred_class_falls_through_to_lower_priority(self):
+        """Work conservation: when EVERY tenant in the top waiting class
+        is token-budget-deferred, admission falls through to the next
+        class instead of idling free slots behind the capped queue."""
+        sched = _parked()
+        sched.tenants = TenantBook(
+            policies=parse_tenant_budgets("capped:1:0:64,free:1")
+        )
+        running = sched.submit(
+            PROMPT, _gen(tenant="capped", max_new_tokens=46, priority=2)
+        )
+        sched._waiting.remove(running)
+        sched._slots[0] = running
+        # the only top-class candidate is budget-held...
+        sched.submit(PROMPT, _gen(tenant="capped", priority=2))
+        lo = sched.submit(PROMPT, _gen(tenant="free", priority=0))
+        # ...so the lower class admits rather than nobody
+        assert sched._next_admission_locked() is lo
+
+
+class TestShedOrdering:
+    """429s land on the lowest priority class first."""
+
+    def _full_queue(self, priorities, max_queue=None):
+        sched = _parked()
+        sched.max_queue = max_queue if max_queue is not None else len(priorities)
+        seqs = [sched.submit(PROMPT, _gen(priority=p)) for p in priorities]
+        return sched, seqs
+
+    def _shed_error(self, seq):
+        item = seq.out.get_nowait()
+        assert isinstance(item, QueueFullError), item
+        return item
+
+    def test_arrival_evicts_newest_of_lowest_class(self):
+        sched, seqs = self._full_queue([0, 1, 0])
+        arrival = sched.submit(PROMPT, _gen(priority=2))
+        # newest priority-0 (index 2) was evicted, not the older one
+        assert seqs[2] not in sched._waiting
+        assert seqs[0] in sched._waiting and seqs[1] in sched._waiting
+        assert arrival in sched._waiting
+        err = self._shed_error(seqs[2])
+        assert err.retry_after_s > 0
+        assert seqs[2].trace.status == "shed"
+
+    def test_priority_ladder_drains_bottom_up(self):
+        sched, seqs = self._full_queue([0, 1, 2])
+        sched.submit(PROMPT, _gen(priority=2))  # evicts the 0
+        self._shed_error(seqs[0])
+        sched.submit(PROMPT, _gen(priority=2))  # then the 1
+        self._shed_error(seqs[1])
+        # only priority-2 requests remain: an equal arrival sheds ITSELF
+        with pytest.raises(QueueFullError):
+            sched.submit(PROMPT, _gen(priority=2))
+        assert seqs[2] in sched._waiting
+
+    def test_equal_priorities_keep_fifo_no_eviction(self):
+        sched, seqs = self._full_queue([1, 1, 1])
+        s0 = _counter("scheduler.requests_shed")
+        with pytest.raises(QueueFullError):
+            sched.submit(PROMPT, _gen(priority=1))
+        assert all(s in sched._waiting for s in seqs)
+        assert _counter("scheduler.requests_shed") == s0 + 1
+
+    def test_lower_priority_arrival_sheds_itself(self):
+        sched, seqs = self._full_queue([2, 2, 2])
+        with pytest.raises(QueueFullError):
+            sched.submit(PROMPT, _gen(priority=0))
+        assert all(s in sched._waiting for s in seqs)
+
+    def test_per_tenant_queue_cap(self):
+        sched = _parked()
+        sched.max_queue = 0  # only the tenant cap below applies
+        sched.tenants = TenantBook(
+            policies=parse_tenant_budgets("capped:1:2,free:1")
+        )
+        a = sched.submit(PROMPT, _gen(tenant="capped", priority=0))
+        sched.submit(PROMPT, _gen(tenant="capped", priority=1))
+        # the cap binds per tenant: other tenants are unaffected
+        sched.submit(PROMPT, _gen(tenant="free"))
+        # an equal-priority arrival over the cap sheds itself...
+        with pytest.raises(QueueFullError, match="capped"):
+            sched.submit(PROMPT, _gen(tenant="capped", priority=0))
+        # ...a higher-priority one evicts within the tenant's own queue
+        sched.submit(PROMPT, _gen(tenant="capped", priority=2))
+        assert a not in sched._waiting
+        self_err = a.out.get_nowait()
+        assert isinstance(self_err, QueueFullError)
+
+    def test_tenant_shed_metrics_move(self):
+        t0 = _counter("tenant.solo.sheds")
+        sched, _ = self._full_queue([0])
+        sched.submit(PROMPT, _gen(priority=1, tenant="solo"))  # evicts the 0
+        with pytest.raises(QueueFullError):  # only solo's own p1 left
+            sched.submit(PROMPT, _gen(priority=1, tenant="solo"))
+        assert _counter("tenant.solo.sheds") == t0 + 1
+
+    def test_evicted_victim_counts_into_requests_shed(self):
+        """A queue-evicted victim is a shed request like any other
+        backpressure rejection (the trace.py 'shed' phase contract)."""
+        sched, _ = self._full_queue([0])
+        s0 = _counter("scheduler.requests_shed")
+        sched.submit(PROMPT, _gen(priority=1))  # evicts the priority-0
+        assert _counter("scheduler.requests_shed") == s0 + 1
+
+    def test_append_time_cap_check_backstops_a_stale_precheck(self, monkeypatch):
+        """Concurrent submits can all pass _check_queue_caps against the
+        same stale depth; the cap is ENFORCED in the same locked section
+        that appends. Simulate the race by disabling the pre-check."""
+        sched = _parked()
+        sched.max_queue = 1
+        monkeypatch.setattr(sched, "_check_queue_caps",
+                            lambda *a, **k: None)
+        first = sched.submit(PROMPT, _gen(priority=1))
+        # equal priority: the arrival itself sheds at append time
+        with pytest.raises(QueueFullError):
+            sched.submit(PROMPT, _gen(priority=1))
+        assert list(sched._waiting) == [first]
+        # higher priority: the append-time check still evicts in order
+        arrival = sched.submit(PROMPT, _gen(priority=2))
+        assert list(sched._waiting) == [arrival]
+        assert isinstance(first.out.get_nowait(), QueueFullError)
+        assert first.trace.status == "shed"
+
+
+class TestPriorityPreemption:
+    """A high-priority arrival with all slots busy evicts a strictly
+    lower-priority victim; the victim resumes byte-identically."""
+
+    def _victim_scenario(self, victim_gen, ref_gen=None):
+        """batch_size=1: the victim owns the only slot, the arrival can
+        only run by preempting it. Reference runs FIRST on the same
+        engine (same compiled programs, same page geometry) — the claim
+        is that the preemption round-trip changes nothing."""
+        eng = _make(batch_size=1, page_size=16, num_pages=64)
+        sched = eng.scheduler
+        sched.prefill_chunk = 8  # resumed prefill uses the chunked path
+        ref = list(sched.stream(PROMPT, ref_gen or victim_gen))
+
+        p0 = _counter("scheduler.priority_preemptions")
+        victim = sched.submit(PROMPT, victim_gen)
+        out: list = []
+
+        def drain_victim():
+            out.extend(sched.drain(victim))
+
+        t = threading.Thread(target=drain_victim)
+        t.start()
+        # the victim must survive a dispatch (its admission shield) and
+        # have tokens in flight before the high-priority arrival lands
+        deadline = time.monotonic() + 60
+        while len(victim.generated) < 3 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert len(victim.generated) >= 3, "victim never started decoding"
+        hi = list(sched.stream(PROMPT, _gen(priority=2, max_new_tokens=8)))
+        t.join(timeout=300)
+        assert len(hi) == 8
+        assert _counter("scheduler.priority_preemptions") > p0
+        assert out == ref, "victim diverged across the preemption"
+        phases = [p for p, _ in victim.trace.events]
+        assert "preempted" in phases and "resumed" in phases
+        assert phases.index("resumed") > phases.index("preempted")
+
+    @pytest.mark.slow  # pipeline `tenancy_tests` stage runs these for
+    # real; tier-1's budget keeps only the queue-order pins above
+    def test_victim_resumes_byte_identical_greedy(self):
+        self._victim_scenario(_gen(max_new_tokens=48, priority=0))
+
+    @pytest.mark.slow
+    def test_victim_resumes_byte_identical_seeded(self):
+        self._victim_scenario(
+            _gen(max_new_tokens=48, priority=0,
+                 temperature=1.0, top_k=40, seed=107),
+        )
+
+    def test_equal_priority_never_slot_preempts(self):
+        """Uniform-priority traffic keeps the legacy wait-for-a-slot
+        behavior: _pick_victim with max_priority below every running
+        class finds nothing."""
+        from fei_tpu.engine.scheduler import _Seq
+
+        eng = _make()
+        sched = eng.scheduler
+        a = _Seq(prompt_ids=PROMPT, gen=_gen(), mask_fn=None, stops=set(),
+                 budget=16, priority=1)
+        a.generated = [1] * 4
+        sched._slots[0] = a
+        assert sched._pick_victim(exclude=None, max_priority=0) is None
+        assert sched._pick_victim(exclude=None, max_priority=1) is a
+
+    def test_victim_order_is_priority_then_progress(self):
+        from fei_tpu.engine.scheduler import _Seq
+
+        eng = _make(batch_size=3)
+        sched = eng.scheduler
+        low_far = _Seq(prompt_ids=PROMPT, gen=_gen(), mask_fn=None,
+                       stops=set(), budget=16, priority=0)
+        low_far.generated = [1] * 14
+        low_near = _Seq(prompt_ids=PROMPT, gen=_gen(), mask_fn=None,
+                        stops=set(), budget=16, priority=0)
+        low_near.generated = [1] * 2
+        mid = _Seq(prompt_ids=PROMPT, gen=_gen(), mask_fn=None,
+                   stops=set(), budget=16, priority=1)
+        mid.generated = [1]  # least progressed but higher class
+        for i, s in enumerate([low_far, low_near, mid]):
+            sched._slots[i] = s
+        assert sched._pick_victim(exclude=None, max_priority=1) is low_near
+
+
+class TestServerPlumbing:
+    """tenant/priority/deadline ride the body or the X-FEI-* headers
+    into GenerationConfig overrides (no engine needed)."""
+
+    def test_body_fields(self):
+        from fei_tpu.ui.server import _gen_overrides
+
+        over = _gen_overrides(
+            {"tenant": "gold", "priority": 2, "deadline_s": 5}, {}
+        )
+        assert over["tenant"] == "gold"
+        assert over["priority"] == 2
+        assert over["deadline_s"] == 5.0
+
+    def test_headers_and_body_precedence(self):
+        from fei_tpu.ui.server import _gen_overrides
+
+        over = _gen_overrides({}, {"X-FEI-Tenant": "silver",
+                                   "X-FEI-Priority": "1"})
+        assert over["tenant"] == "silver" and over["priority"] == 1
+        over = _gen_overrides({"tenant": "gold"},
+                              {"x-fei-tenant": "silver"})
+        assert over["tenant"] == "gold"  # body wins
+        over = _gen_overrides({"priority": "soon"}, {})
+        assert "priority" not in over  # junk drops, not 500s
+
+    def test_propagated_deadline_folds_min(self):
+        from fei_tpu.ui.server import _gen_overrides
+
+        over = _gen_overrides({"deadline_s": 9},
+                              {"X-FEI-Deadline-S": "2.5"})
+        assert over["deadline_s"] == 2.5
+        over = _gen_overrides({"deadline_s": 1},
+                              {"X-FEI-Deadline-S": "30"})
+        assert over["deadline_s"] == 1.0
+        # an already-expired propagated budget clamps to an epsilon (0
+        # would mean "no deadline") so the scheduler sheds it on arrival
+        over = _gen_overrides({}, {"X-FEI-Deadline-S": "0"})
+        assert over["deadline_s"] == pytest.approx(1e-3)
+
+    def test_submit_resolves_and_sanitizes(self):
+        sched = _parked()
+        seq = sched.submit(
+            PROMPT, _gen(tenant="team a!", priority=99)
+        )
+        assert seq.tenant == "team_a_"
+        assert seq.priority == 9  # clamped ordinal class
+        anon = sched.submit(PROMPT, _gen())
+        assert anon.tenant == sched.tenants.default_tenant
