@@ -37,6 +37,13 @@ Suites (FEI_TPU_BENCH_SUITE):
                      aggregate tok/s, slot counts (dp multiplies them) and
                      a greedy token-parity probe vs the ms1 rung; on a CPU
                      backend it re-execs onto the 8-device host mesh
+  kvtier           — tiered KV store under 10x slot oversubscription
+                     (FEI_TPU_BENCH_OVERSUB): park/resume latency
+                     percentiles, goodput with spill/streamed-resume on,
+                     the recomputed-tokens-flat-while-pages-restored-climbs
+                     acceptance numbers, and the affinity-miss TTFT cost
+                     before vs after a cross-replica KV migration. Every
+                     line stamps kv.tier_bytes_{ram,disk}
   fleet            — bursty multi-tenant overload through the fleet router
                      (2 in-process replicas): per-tenant p99 TTFT, goodput
                      and shed counts at ~2x capacity, with a zero-downtime
@@ -1075,6 +1082,182 @@ def bench_fleet(model: str, n_tokens: int) -> int:
                  unit="tok/s", extra=extra)
 
 
+def bench_kvtier(model: str, n_tokens: int) -> int:
+    """Tiered KV store under heavy slot oversubscription + migration.
+
+    Phase 1 — park/resume: FEI_TPU_BENCH_OVERSUB (default 10) sessions
+    per slot hammer a deliberately tight paged pool with the host tier
+    on (FEI_TPU_KV_TIER, default ram), so the scheduler constantly parks
+    and resumes sequences. The acceptance shape is in the extras:
+    ``preempted_tokens_recomputed`` stays flat (streamed resume, not
+    re-prefill) while ``kv.pages_restored`` climbs with the preemption
+    count; every stream must deliver its full token budget (zero lost
+    tokens). Park/resume latency comes from the kv_spill/kv_fetch span
+    histograms.
+
+    Phase 2 — migration: a warm replica exports its session blob; the
+    TTFT of the same prompt on a cold replica (re-prefill) vs on a cold
+    replica that imported the blob first is the affinity-miss cost
+    before/after migration."""
+    import threading
+
+    from fei_tpu.engine.engine import GenerationConfig
+    from fei_tpu.utils.metrics import METRICS
+
+    os.environ.setdefault("FEI_TPU_KV_TIER", "ram")
+    oversub = max(2, int(os.environ.get("FEI_TPU_BENCH_OVERSUB", "10")))
+    budget = min(n_tokens, 24)
+    batch = 2
+
+    # tight pool: room for ~1.5 active sequences so concurrent streams
+    # must park; page_size 4 keeps page counts meaningful at tiny scale
+    engine = _make_engine(
+        model, max_seq_len=256, paged=True, batch_size=batch, page_size=4,
+        num_pages=14, prefix_cache=True,
+    )
+    sched = engine.scheduler
+    sessions = batch * oversub
+    base_prompt = _prompt(engine)[:18]
+    prompts = [list(base_prompt[:-1]) + [i + 2] for i in range(sessions)]
+    gen = GenerationConfig(max_new_tokens=budget, temperature=0.0,
+                           ignore_eos=True)
+
+    c0 = METRICS.snapshot()["counters"]
+    log(f"bench: kvtier parking {sessions} sessions on {batch} slots "
+        f"({oversub}x oversubscription)...")
+    results: list = [None] * sessions
+    t0 = time.perf_counter()
+    seqs = [sched.submit(p, gen) for p in prompts]
+
+    def drain(i):
+        results[i] = list(sched.drain(seqs[i]))
+
+    threads = [threading.Thread(target=drain, args=(i,))
+               for i in range(sessions)]
+    [t.start() for t in threads]
+    [t.join(timeout=600) for t in threads]
+    dt = time.perf_counter() - t0
+    lost = sum(1 for r in results if not r or len(r) != budget)
+    total_tokens = sum(len(r or []) for r in results)
+    snap = METRICS.snapshot()
+    c1, hist = snap["counters"], snap["histograms"]
+
+    def delta(name: str) -> float:
+        return float(c1.get(name, 0)) - float(c0.get(name, 0))
+
+    extra: dict = {
+        "oversubscription": oversub,
+        "sessions": sessions,
+        "lost_streams": lost,
+        "preemptions": delta("scheduler.preemptions"),
+        "preempted_tokens_recomputed": delta(
+            "scheduler.preempted_tokens_recomputed"),
+        "kv_spills": delta("kv.spills"),
+        "kv_pages_restored": delta("kv.pages_restored"),
+        "kv_fetch_fallbacks": delta("kv.fetch_fallbacks"),
+        "park_p50_ms": round(
+            hist.get("kv_spill_seconds", {}).get("p50", 0.0) * 1000, 2),
+        "park_p99_ms": round(
+            hist.get("kv_spill_seconds", {}).get("p99", 0.0) * 1000, 2),
+        "resume_p50_ms": round(
+            hist.get("kv_fetch_seconds", {}).get("p50", 0.0) * 1000, 2),
+        "resume_p99_ms": round(
+            hist.get("kv_fetch_seconds", {}).get("p99", 0.0) * 1000, 2),
+    }
+    log(f"bench: kvtier oversubscription done in {dt:.1f}s: "
+        f"{total_tokens} tokens, preemptions={extra['preemptions']:.0f}, "
+        f"recomputed={extra['preempted_tokens_recomputed']:.0f}, "
+        f"pages_restored={extra['kv_pages_restored']:.0f}, lost={lost}")
+    engine.close()
+
+    # -- phase 2: affinity-miss TTFT, before vs after migration -------------
+    from fei_tpu.agent.providers import JaxLocalProvider
+    from fei_tpu.ui.server import ServeAPI
+
+    def make_api():
+        # pool wide enough for several full sessions: phase 2 measures
+        # admission latency, not pressure — evictions here would hand the
+        # export a partial prefix
+        eng = _make_engine(
+            model, max_seq_len=256, paged=True, batch_size=batch,
+            page_size=4, num_pages=192, prefix_cache=True,
+        )
+        return ServeAPI(JaxLocalProvider(engine=eng), model_name="kvtier")
+
+    # probe and decoys: same length (identical prefill/import shapes, so
+    # one compiles the programs the other then times) but differing from
+    # the FIRST content byte, so the only prefix a decoy can seed for the
+    # probe is the shared chat-template pages
+    def _body(fill: str) -> dict:
+        return {
+            "messages": [{"role": "user", "content":
+                          fill * 160 + " :kvtier migration probe"}],
+            "max_tokens": 1, "temperature": 0,
+        }
+
+    body, decoy, decoy2 = _body("x"), _body("y"), _body("z")
+
+    def ttft_ms(api, req=None) -> float:
+        t0 = time.perf_counter()
+        status, payload = api.handle(
+            "POST", "/v1/chat/completions", dict(req or body), {})[:2]
+        if status != 200:
+            raise RuntimeError(f"kvtier migration probe failed: {payload}")
+        return (time.perf_counter() - t0) * 1000
+
+    def export_blob(api, req) -> str:
+        status, exported = api.handle(
+            "POST", "/kv/export", {"messages": req["messages"]}, {})[:2]
+        if status != 200:
+            raise RuntimeError(f"kvtier export failed: {exported}")
+        return exported["blob"]
+
+    warm = make_api()
+    ttft_ms(warm)               # warms the prefix cache on the source
+    blob = export_blob(warm, body)
+    ttft_ms(warm, decoy)
+    decoy_blob = export_blob(warm, decoy)
+    # jit compile caches are PER ENGINE: each timed replica must amortize
+    # its own admission programs, via untimed same-shape decoy sessions,
+    # before its probe is timed — or one probe eats a one-time compile the
+    # other doesn't. The cold replica needs TWO decoys: the first runs a
+    # clean-cache full prefill, the second the partial template-prefix-hit
+    # geometry the probe will actually take.
+    cold = make_api()
+    ttft_ms(cold, decoy)
+    ttft_ms(cold, decoy2)
+    cold_ms = ttft_ms(cold)     # affinity miss, no migration: re-prefill
+    migrated = make_api()
+
+    def import_blob(api, b) -> dict:
+        status, imported = api.handle(
+            "POST", "/kv/import", {"blob": b}, {})[:2]
+        if status != 200 or not imported.get("pages"):
+            raise RuntimeError(f"kvtier import failed: {imported}")
+        return imported
+
+    import_blob(migrated, decoy_blob)
+    ttft_ms(migrated, decoy)    # untimed: compiles the prefix-hit path
+    imported = import_blob(migrated, blob)
+    migrated_ms = ttft_ms(migrated)  # affinity miss repaired by migration
+    for api in (warm, cold, migrated):
+        api.provider.engine.close()
+    extra["affinity_miss_cold_ttft_ms"] = round(cold_ms, 1)
+    extra["affinity_miss_migrated_ttft_ms"] = round(migrated_ms, 1)
+    extra["migration_pages"] = int(imported["pages"])
+    extra["migration_ttft_speedup"] = (
+        round(cold_ms / migrated_ms, 2) if migrated_ms > 0 else None
+    )
+    log(f"bench: kvtier affinity-miss ttft cold={cold_ms:.1f}ms "
+        f"migrated={migrated_ms:.1f}ms "
+        f"(pages={extra['migration_pages']})")
+    gauges = METRICS.snapshot()["gauges"]
+    extra["kv_tier_bytes_ram"] = int(gauges.get("kv.tier_bytes_ram", 0))
+    extra["kv_tier_bytes_disk"] = int(gauges.get("kv.tier_bytes_disk", 0))
+    return _emit(f"{_tag(model)}_kvtier_oversub_agg_tok_s",
+                 total_tokens / dt, unit="tok/s", extra=extra)
+
+
 def bench_agent(model: str, n_tokens: int) -> int:
     """End-to-end `fei --message` shape (BASELINE config #3): chat template
     -> jax_local provider -> engine stream -> incremental detokenize ->
@@ -1206,6 +1389,9 @@ def main() -> int:
         os.execv(sys.executable, [sys.executable] + sys.argv)
     if suite == "moe":
         default_model = "moe-2b"
+    elif suite == "kvtier":
+        # park/resume churn is about pool pressure, not model weight
+        default_model = "tiny"
     elif suite == "fleet":
         # two engines in one process: tiny keeps the burst about QoS
         # shape, not model weight; override with FEI_TPU_BENCH_MODEL
@@ -1255,6 +1441,8 @@ def main() -> int:
         return bench_moe(model, n_tokens)
     if suite == "fleet":
         return bench_fleet(model, n_tokens)
+    if suite == "kvtier":
+        return bench_kvtier(model, n_tokens)
     if suite == "agent":
         return bench_agent(model, n_tokens)
     return bench_decode(model, n_tokens)

@@ -26,17 +26,23 @@ Points (the lint-style registry below is the source of truth):
 - ``pool.alloc``         — inside the scheduler's page-allocation seam
 - ``router.forward``     — fleet router, before forwarding to a replica
 - ``replica.health``     — fleet router, before a replica health probe
+- ``kv.spill``           — tiered KV store, before a page spill lands
+- ``kv.fetch``           — tiered KV store, before a page fetch returns
 
 Kinds map to exception types: ``request`` → RequestError, ``device`` →
 DeviceError, ``conn`` → urllib URLError, ``http429``/``http503`` →
 urllib HTTPError (with Retry-After: 0 so retry tests stay fast), and
 ``hang`` → TimeoutError (a replica that never answers, surfaced as the
-router's post-timeout error), and
+router's post-timeout error — at ``kv.fetch`` it models a slow fetch
+that blew its budget, which must fall back to token replay), and
 ``exhausted``/``transient`` → PoolPressure (``pool.alloc`` only: the
 scheduler's pressure handler swallows it like a real exhaustion, so the
 chaos sweep exercises preemption with a full-size pool; ``transient``
 documents a pressure spike that clears on the first retry — the
-injector's count expiring models the clearing).
+injector's count expiring models the clearing). The kv points add
+``io`` → OSError (a tier file that cannot be read/written) and
+``corrupt`` → KVTierError (a checksum/version mismatch the unpack path
+would raise itself).
 """
 
 from __future__ import annotations
@@ -65,11 +71,13 @@ POINTS = (
     "pool.alloc",
     "router.forward",    # fleet router: before a forward to a replica
     "replica.health",    # fleet router: before a replica health probe
+    "kv.spill",          # tiered KV store: before a page spill lands
+    "kv.fetch",          # tiered KV store: before a page fetch returns
 )
 
 KINDS = (
     "request", "device", "conn", "http429", "http503",
-    "exhausted", "transient", "hang",
+    "exhausted", "transient", "hang", "io", "corrupt",
 )
 
 
@@ -79,6 +87,12 @@ def _make_exc(kind: str, point: str) -> BaseException:
         return RequestError(msg)
     if kind == "device":
         return DeviceError(msg)
+    if kind == "io":
+        return OSError(msg)
+    if kind == "corrupt":
+        from fei_tpu.utils.errors import KVTierError
+
+        return KVTierError(msg)
     if kind in ("exhausted", "transient"):
         return PoolPressure(msg)
     if kind == "hang":

@@ -178,6 +178,15 @@ class AdmissionMixin:
             )
             self._update_sched_gauges()
             try:
+                # streamed resume (ISSUE 15): a preempted sequence whose
+                # pages live in the KV tier scatters them back and arms in
+                # one hop — no replay, zero tokens recomputed. Any miss,
+                # mismatch, or tier failure falls through to the chunked
+                # replay route below, which is always correct.
+                if seq.generated and self._try_streamed_resume(
+                    seq, slot, prefix
+                ):
+                    continue
                 # long prompts on an sp mesh admit SEQUENCE-SHARDED in one
                 # dispatch (ring-attention full-model prefill via
                 # engine.prefill's routing) — n× fewer dispatches than
@@ -767,11 +776,15 @@ class AdmissionMixin:
         self._deliver(seq, tok0)
 
 
-    def _resume_delivered(self, seq: _Seq, n: int, prefix_pages: int) -> None:
+    def _resume_delivered(self, seq: _Seq, n: int, prefix_pages: int,
+                          recomputed: int | None = None) -> None:
         """Resume tail shared by both admission paths: the stream
         continues byte-identically — no token re-delivered, none dropped.
         A warm-restart replay re-emits the recorded prefix to the fresh
-        consumer first (the old process's queue is gone)."""
+        consumer first (the old process's queue is gone). ``recomputed``
+        overrides the replay-cost accounting — a streamed-page resume
+        passes 0 (it recomputes nothing; that flat counter next to a
+        climbing ``kv.pages_restored`` is the tier's whole win)."""
         alloc = self.engine._allocator
         seq.next_input = seq.generated[-1]
         if seq.trace is not None:
@@ -782,7 +795,8 @@ class AdmissionMixin:
         )
         METRICS.incr(
             "scheduler.preempted_tokens_recomputed",
-            max(0, n - prefix_pages * alloc.page_size),
+            max(0, n - prefix_pages * alloc.page_size)
+            if recomputed is None else recomputed,
         )
         if seq.replay:
             for t in seq.generated:
@@ -791,6 +805,101 @@ class AdmissionMixin:
         if len(seq.generated) >= seq.budget:
             self._finish(seq)
 
+
+    def _try_streamed_resume(
+        self, seq: _Seq, slot: int, prefix: list[int]
+    ) -> bool:
+        """Resume a preempted sequence by scattering its spilled pages
+        back from the KV tier instead of replaying tokens. True = the
+        slot is armed and the stream continues (zero tokens recomputed);
+        False = no usable entry — the caller falls through to the chunked
+        replay route. Only ``PoolPressure`` escapes (from the shared
+        reservation, to the caller's requeue handler); every tier-side
+        failure converts to a replay fallback here.
+
+        Byte-identity argument: the entry's arrays are the exact pool
+        bytes the slot held at preemption (``_spill_seq`` gathers after
+        verifying the device length). Prefix pages the registry shares
+        into the slot are never overwritten — a live co-resident may be
+        attending them — and the replay route reads those same physical
+        pages, so both resume paths see identical prefix bytes; the
+        non-shared suffix is restored bitwise. The saved per-slot PRNG
+        key re-installs exactly as on the replay path."""
+        tier = self._kv_tier
+        if tier is None or seq.resume_key is None:
+            return False
+        if getattr(self.engine.cfg, "sliding_window", None):
+            return False
+        from fei_tpu.kv.pagesio import pool_fingerprint, scatter_pages
+        from fei_tpu.obs.costmodel import account_kv_transfer
+
+        alloc = self.engine._allocator
+        ids = self._prefill_ids(seq)
+        n = len(ids)
+        try:
+            entry = tier.fetch(seq.rid)
+        except Exception as exc:  # noqa: BLE001 — corrupt file, I/O
+            # error, injected hang: all mean "replay instead"
+            METRICS.incr("kv.fetch_fallbacks")
+            log.warning(
+                "kv fetch for %s failed (%r); falling back to replay",
+                seq.rid, exc,
+            )
+            return False
+        if entry is None:
+            return False
+        need = alloc.pages_needed(n)
+        if (
+            entry.n_tokens != n
+            or entry.page_size != self.engine.page_size
+            or entry.n_pages < need
+            or entry.fingerprint != pool_fingerprint(self._pool)
+        ):
+            # stale (the sequence decoded past the spill) or from an
+            # incompatible pool: useless now and forever — drop it
+            tier.drop(seq.rid)
+            METRICS.incr("kv.fetch_fallbacks")
+            return False
+        # commits pages to the slot; PoolPressure propagates to the
+        # caller's requeue handler exactly like the replay routes
+        m = self._reserve_admission(seq, slot, prefix)
+        t0 = time.perf_counter()
+        pages = alloc.pages_for(slot)
+        with METRICS.span("kv_fetch"):
+            self._pool = scatter_pages(
+                self._pool, pages[m:need],
+                {k: v[m:need] for k, v in entry.arrays.items()},
+            )
+        row = self._slot_row(slot)
+        self._pool = self._arm_fn()(
+            self._pool, jnp.asarray(row), jnp.int32(slot),
+            jnp.asarray(n, dtype=jnp.int32),
+        )
+        self._keys = self._keys.at[slot].set(
+            jnp.asarray(seq.resume_key, dtype=jnp.uint32)
+        )
+        t1 = time.perf_counter()
+        seq.prefilling = False
+        seq.row = np.array(row)
+        if seq.trace is not None:
+            seq.trace.event("prefill")
+        if self._prefix is not None:
+            self._prefix.register(ids, pages[:need])
+        restored = need - m
+        METRICS.incr("kv.fetches")
+        METRICS.incr("kv.pages_restored", restored)
+        nbytes = sum(
+            int(v[m:need].nbytes) for v in entry.arrays.values()
+        )
+        account_kv_transfer("fetched", nbytes, t1 - t0)
+        FLIGHT.dispatch(
+            "dispatch.kv_fetch", t0, t1, t1, rid=seq.rid,
+            mesh=mesh_tag(self.engine.mesh), slot=slot,
+            pages=restored, bytes=nbytes,
+        )
+        tier.drop(seq.rid)  # one-shot: a later preemption re-spills
+        self._resume_delivered(seq, n, prefix_pages=m, recomputed=0)
+        return True
 
     def _gather_fn(self, gm: int, bucket: int):
         """Compiled prefix gather: ``gm`` (power-of-two padded) cached pages

@@ -293,6 +293,18 @@ class PagedScheduler(AdmissionMixin, DecodeMixin, ConstraintMixin):
         self._ggrammar = None
         self._gtable = None
         self._gmind = None
+        # tiered KV store (fei_tpu/kv): a preempted slot's pages spill to
+        # host RAM (and past the budget, disk) so resume streams bytes
+        # back instead of replaying tokens. None = off (FEI_TPU_KV_TIER),
+        # which is exactly the pre-tier replay behavior.
+        from fei_tpu.kv.tier import KVTierStore, TierConfig
+
+        _tier_cfg = TierConfig.from_env()
+        self._kv_tier = KVTierStore(_tier_cfg) if _tier_cfg.enabled else None
+        # control-plane closures (KV export/import for migration) run on
+        # the loop thread between dispatches — the donated pool is
+        # single-owner state and must never race a dispatch
+        self._ctl: deque = deque()
 
     # -- public API ---------------------------------------------------------
 
@@ -633,6 +645,8 @@ class PagedScheduler(AdmissionMixin, DecodeMixin, ConstraintMixin):
             if seq in self._waiting:
                 self._waiting.remove(seq)
                 seq.finished = True
+                if self._kv_tier is not None:  # a preempted waiter's
+                    self._kv_tier.drop(seq.rid)  # spilled pages die here
                 self._trace_finish(seq, "cancelled")
                 return
             seq.cancelled = True
@@ -668,6 +682,83 @@ class PagedScheduler(AdmissionMixin, DecodeMixin, ConstraintMixin):
         if thread is not None and thread.is_alive():
             thread.join(timeout=30)
 
+    # -- control-plane closures on the loop thread --------------------------
+
+    def run_ctl(self, fn, timeout_s: float = 60.0):
+        """Run ``fn`` on the scheduler loop thread between dispatches and
+        return its result (KV export/import use this: the donated pool is
+        single-owner state). With the loop parked there is no dispatch to
+        race, so ``fn`` runs inline under the lock; a live loop services
+        the queue at the top of its next iteration."""
+        box: dict = {}
+        done = threading.Event()
+        with self._lock:
+            alive = self._thread is not None and self._thread.is_alive()
+            if alive:
+                self._ctl.append((fn, box, done))
+        if not alive:
+            # inline OUTSIDE the lock: the closure itself may take it
+            # (_ensure_pool does); with the loop parked there is no
+            # dispatch for it to race
+            return fn()
+        self._wake.set()
+        deadline = time.perf_counter() + timeout_s
+        while not done.wait(timeout=0.05):
+            if time.perf_counter() > deadline:
+                raise EngineError(
+                    f"scheduler ctl call timed out after {timeout_s}s"
+                )
+            reclaimed = False
+            with self._lock:
+                alive = self._thread is not None and self._thread.is_alive()
+                if not alive:
+                    # the loop parked/died between enqueue and service:
+                    # reclaim our entry and run inline (no dispatch races
+                    # a dead loop)
+                    try:
+                        self._ctl.remove((fn, box, done))
+                        reclaimed = True
+                    except ValueError:
+                        pass  # already picked up; keep waiting
+            if reclaimed:
+                return fn()
+        if "exc" in box:
+            raise box["exc"]
+        return box.get("result")
+
+    def _run_ctl_pending(self) -> None:
+        """Service queued control closures (loop thread only). A closure's
+        exception fails its caller, never the loop."""
+        while True:
+            with self._lock:
+                if not self._ctl:
+                    return
+                fn, box, done = self._ctl.popleft()
+            try:
+                box["result"] = fn()
+            except BaseException as exc:  # noqa: BLE001
+                box["exc"] = exc
+            finally:
+                done.set()
+
+    def export_prefix(self, prompt_ids) -> bytes | None:
+        """Serialize the longest page-aligned cached prefix of
+        ``prompt_ids`` as a portable migration blob (kv/migrate.py), or
+        None when nothing is cached. Safe from any thread."""
+        from fei_tpu.kv.migrate import export_blob
+
+        ids = [int(t) for t in prompt_ids]
+        return self.run_ctl(lambda: export_blob(self, ids))
+
+    def import_prefix(self, blob: bytes) -> int:
+        """Scatter a migration blob into this scheduler's pool + prefix
+        cache; returns pages landed (0 = refused for lack of room).
+        Raises KVTierError on a corrupt/mismatched blob. Safe from any
+        thread."""
+        from fei_tpu.kv.migrate import import_blob
+
+        return self.run_ctl(lambda: import_blob(self, blob))
+
     _IDLE_PARKS = 600  # ~60 s of nothing to do -> park the thread
 
     def _loop(self) -> None:
@@ -687,6 +778,7 @@ class PagedScheduler(AdmissionMixin, DecodeMixin, ConstraintMixin):
                             return
                     continue
                 self._reap_cancelled()
+                self._run_ctl_pending()
                 if self._draining:
                     if self._admitting is not None:
                         # an ACCEPTED chunked admission finishes its
@@ -850,6 +942,8 @@ class PagedScheduler(AdmissionMixin, DecodeMixin, ConstraintMixin):
         seq.finished = True
         if seq.gfallback_state is not None:
             seq.gaccepted = bool(seq.gfallback_state.get("accepted"))
+        if self._kv_tier is not None:
+            self._kv_tier.drop(seq.rid)
         slot = seq.slot
         if slot >= 0 and self._slots[slot] is seq:
             self._evict_slot(slot)
@@ -1061,6 +1155,11 @@ class PagedScheduler(AdmissionMixin, DecodeMixin, ConstraintMixin):
                 # chain is bit-identical; a victim still (re-)prefilling
                 # keeps whatever resume_key it already carried
                 seq.resume_key = np.asarray(self._keys[slot])
+                # spill-before-preempt (ISSUE 15): copy the slot's settled
+                # pages into the host tier so the re-admission streams
+                # bytes back instead of replaying tokens. Best-effort —
+                # preemption itself never depends on the tier.
+                self._spill_seq(seq, slot)
             self._evict_slot(slot)
         st = self._admitting
         if st is not None and st.get("seq") is seq:
@@ -1089,12 +1188,68 @@ class PagedScheduler(AdmissionMixin, DecodeMixin, ConstraintMixin):
                 with self._lock:
                     self._waiting.append(seq)
 
+    def _spill_seq(self, seq: _Seq, slot: int) -> None:
+        """Copy a settled, about-to-be-preempted slot's pages into the
+        host tier, keyed by request id. Loop thread only (reads the live
+        pool). Every skip/failure is silent toward the caller: the replay
+        path remains the always-correct resume."""
+        tier = self._kv_tier
+        if tier is None or not seq.generated:
+            return
+        if getattr(self.engine.cfg, "sliding_window", None):
+            # rolling-window slots release leading pages mid-decode;
+            # spilled pages would misalign at scatter — replay covers
+            return
+        from fei_tpu.kv.pagesio import gather_pages, pool_fingerprint
+        from fei_tpu.kv.tier import PageEntry
+        from fei_tpu.obs.costmodel import account_kv_transfer
+
+        try:
+            alloc = self.engine._allocator
+            n = len(self._prefill_ids(seq))
+            need = alloc.pages_needed(n)
+            pages = alloc.pages_for(slot)[:need]
+            if len(pages) < need:
+                return  # below-window release or partial state: replay
+            # the device length must match the host token count, or the
+            # entry would arm a resumed slot at the wrong position
+            if int(jax.device_get(self._pool.lengths[slot])) != n:
+                return
+            t0 = time.perf_counter()
+            with METRICS.span("kv_spill"):
+                arrays = gather_pages(self._pool, pages)
+            entry = PageEntry(
+                key=seq.rid, n_tokens=n, page_size=self.engine.page_size,
+                fingerprint=pool_fingerprint(self._pool), arrays=arrays,
+            )
+            tier.put(seq.rid, entry)
+            t1 = time.perf_counter()
+            METRICS.incr("kv.spills")
+            METRICS.incr("kv.pages_spilled", need)
+            account_kv_transfer("spilled", entry.nbytes, t1 - t0)
+            FLIGHT.dispatch(
+                "dispatch.kv_spill", t0, t1, t1, rid=seq.rid, slot=slot,
+                pages=need, bytes=entry.nbytes,
+            )
+        except Exception as exc:  # noqa: BLE001 — a failed spill only
+            # costs the fast resume; the preemption proceeds regardless
+            METRICS.incr("kv.spill_failures")
+            log.warning("kv spill of %s failed: %r", seq.rid, exc)
+
     def _ensure_free(self, seq: _Seq, n: int, *, preempt: bool,
                      locked: bool = True) -> bool:
         """Make ``n`` pages free for ``seq``: first ask the prefix cache
         to give up unpinned entries, then (when allowed) preempt victims
         one at a time — least progress first, never the requester.
         False when the demand cannot be met (caller blocks or requeues).
+
+        With the KV tier on (FEI_TPU_KV_TIER), the preempt rung spills
+        before it evicts: ``_preempt_seq`` copies the victim's settled
+        pages into the host tier on the way out, so the ladder is
+        prefix-evict → spill-to-tier+preempt — the victim's re-admission
+        then streams its pages back (``_try_streamed_resume``) instead of
+        recomputing them, and pressure costs bytes moved, not tokens
+        replayed.
 
         The ``pool.alloc`` fault point is checked once per attempt, so an
         armed ``exhausted:N`` models pressure persisting N attempts

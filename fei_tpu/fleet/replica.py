@@ -60,6 +60,15 @@ class InProcessReplica:
         return getattr(self.api.provider, "engine", None)
 
     @property
+    def role(self) -> str:
+        """The replica's fleet role (``mixed`` / ``prefill-heavy`` /
+        ``decode-heavy``) — owned by the ServeAPI (ctor ``role=`` or
+        FEI_TPU_REPLICA_ROLE) and reported on ``/health``; the router
+        reads it off the health payload, this property is for tests and
+        in-process tooling."""
+        return getattr(self.api, "role", "mixed")
+
+    @property
     def can_restart(self) -> bool:
         """True when ``restart()`` can rebuild this replica in-place —
         the router's rolling restart checks this BEFORE draining
@@ -131,10 +140,14 @@ class InProcessReplica:
 class HttpReplica:
     """A remote ``fei serve`` endpoint behind the same contract."""
 
-    def __init__(self, rid: str, base_url: str, timeout_s: float = 30.0):
+    def __init__(self, rid: str, base_url: str, timeout_s: float = 30.0,
+                 role: str = "mixed"):
         self.rid = rid
         self.base_url = base_url.rstrip("/")
         self.timeout_s = timeout_s
+        # informational default; the router trusts the /health payload
+        # (the remote process knows its own FEI_TPU_REPLICA_ROLE)
+        self.role = role
 
     def request(self, method: str, path: str, body: dict | None = None,
                 headers: dict | None = None) -> tuple[int, dict, dict]:

@@ -8,9 +8,27 @@ Routing policy, in priority order:
    conversations keep hitting their warm prefix cache. Affinity degrades
    gracefully — a draining/ejected target falls back to least-loaded
    (``router.affinity_misses``) instead of queueing behind a drain.
-2. **Least-loaded** — among usable replicas, the one with the lowest
-   ``(queue_depth + running) / slots`` read off its ``/health`` capacity
-   fields (TTL-cached, ``FEI_TPU_FLEET_HEALTH_TTL_S``).
+2. **Role fit** — replicas advertise a role on ``/health`` (``mixed`` /
+   ``prefill-heavy`` / ``decode-heavy``, FEI_TPU_REPLICA_ROLE). When the
+   fleet is split, prompts estimated at ≥
+   ``FEI_TPU_ROUTER_PREFILL_TOKENS`` prefer prefill-heavy replicas and
+   short/decode work avoids them; an all-``mixed`` fleet skips the
+   filter entirely. Preference, not a hard partition — an empty
+   preferred set falls back to every usable replica.
+3. **Least-loaded** — among the remaining replicas, the one with the
+   lowest ``(queue_depth + running) / slots`` read off its ``/health``
+   capacity fields (TTL-cached, ``FEI_TPU_FLEET_HEALTH_TTL_S``).
+
+Warm-state mobility (kv/migrate.py via ``POST /kv/export`` →
+``POST /kv/import``): when a session's remembered replica is out of
+rotation (draining, ejected) and the request lands elsewhere, the router
+best-effort moves the cached KV prefix to the new home before
+forwarding (``router.migrations`` / ``router.migration_failures``);
+after a prefill-heavy replica finishes a request it hands the prefix to
+the least-loaded decode-heavy replica and re-pins the session's
+affinity there, so follow-up turns decode where decode is cheap. Both
+moves are strictly best-effort: any failure costs one re-prefill,
+exactly the pre-migration world.
 
 Failure handling:
 
@@ -82,7 +100,7 @@ class _ReplicaState:
     """Router-side view of one replica (health cache + breaker)."""
 
     __slots__ = ("fails", "ejected_until", "draining", "healthy",
-                 "queue_depth", "running", "slots", "last_probe")
+                 "queue_depth", "running", "slots", "last_probe", "role")
 
     def __init__(self):
         self.fails = 0
@@ -93,6 +111,7 @@ class _ReplicaState:
         self.running = 0
         self.slots = 1
         self.last_probe = 0.0      # monotonic; 0 = never probed
+        self.role = "mixed"        # /health "role"; mixed until probed
 
     def load(self) -> float:
         return (self.queue_depth + self.running) / max(self.slots, 1)
@@ -141,6 +160,11 @@ class Router:
             _env_float("FEI_TPU_FLEET_HEALTH_TTL_S", 1.0)
             if health_ttl_s is None else float(health_ttl_s)
         )
+        # prompt size (estimated tokens) at which a request counts as
+        # prefill-heavy for the role filter
+        self.prefill_tokens = max(
+            1, _env_int("FEI_TPU_ROUTER_PREFILL_TOKENS", 512)
+        )
         self._affinity: OrderedDict[str, str] = OrderedDict()
         self._lock = threading.Lock()
 
@@ -167,6 +191,7 @@ class Router:
         st.queue_depth = int(payload.get("queue_depth") or 0)
         st.running = int(payload.get("running") or 0)
         st.slots = int(payload.get("slots") or 1)
+        st.role = str(payload.get("role") or "mixed")
         if st.healthy:
             # deliberately does NOT reset st.fails: a replica can answer
             # /health while failing real forwards, and a passing probe
@@ -240,8 +265,35 @@ class Router:
                 return f"prefix:{digest}"
         return None
 
+    def _role_pref(self, body: dict) -> str | None:
+        """Which side of the role split this request belongs on:
+        ``"prefill"`` for prompts estimated at ≥ ``prefill_tokens``,
+        ``"decode"`` otherwise, None when every replica is ``mixed``
+        (no split to honor — skip the char-count walk entirely)."""
+        for rid in self._order:
+            # roles come from /health; a never-probed state would read as
+            # "mixed" and silently disable the split for the first picks
+            if self._state[rid].last_probe == 0.0:
+                self._probe(rid)
+        if all(self._state[r].role == "mixed" for r in self._order):
+            return None
+        chars = 0
+        msgs = body.get("messages")
+        for m in msgs if isinstance(msgs, list) else []:
+            if not isinstance(m, dict):
+                continue
+            c = m.get("content")
+            if isinstance(c, str):
+                chars += len(c)
+            elif c:
+                chars += len(json.dumps(c))
+        # ~4 chars/token: close enough to split long from short without
+        # tokenizing in the router
+        return "prefill" if chars // 4 >= self.prefill_tokens else "decode"
+
     def _pick(self, key: str | None, exclude=(),
-              force: bool = False) -> str | None:
+              force: bool = False,
+              role_pref: str | None = None) -> str | None:
         cands = self._candidates(force=force, exclude=exclude)
         if not cands:
             return None
@@ -249,10 +301,22 @@ class Router:
             with self._lock:
                 rid = self._affinity.get(key)
             if rid is not None:
+                # affinity outranks role fit: a warm prefix cache beats
+                # landing on the "right" role cold
                 if rid in cands:
                     METRICS.incr("router.affinity_hits")
                     return rid
                 METRICS.incr("router.affinity_misses")
+        if role_pref is not None:
+            if role_pref == "prefill":
+                pref = [r for r in cands
+                        if self._state[r].role == "prefill-heavy"]
+            else:
+                pref = [r for r in cands
+                        if self._state[r].role != "prefill-heavy"]
+            if pref and len(pref) < len(cands):
+                METRICS.incr("router.role_routed")
+            cands = pref or cands
         return min(cands, key=lambda r: self._state[r].load())
 
     def _remember(self, key: str | None, rid: str) -> None:
@@ -263,6 +327,74 @@ class Router:
             self._affinity.move_to_end(key)
             while len(self._affinity) > self.affinity_cap:
                 self._affinity.popitem(last=False)
+
+    # -- kv migration (warm-state mobility) ---------------------------------
+
+    def _migrate(self, src: str, dst: str, body: dict) -> bool:
+        """Best-effort move of the cached KV prefix for ``body``'s prompt
+        from ``src`` to ``dst`` over the /kv control plane. Never raises;
+        any failure just costs the re-prefill that would have happened
+        anyway. A 404 export (nothing cached) is a no-op, not a failure."""
+        msgs = body.get("messages")
+        if not isinstance(msgs, list) or not msgs:
+            return False
+        try:
+            status, payload, _ = self.replicas[src].request(
+                "POST", "/kv/export",
+                {"messages": msgs, "tools": body.get("tools")},
+            )
+            if status == 404:
+                return False  # cold source: nothing to move
+            blob = payload.get("blob") if isinstance(payload, dict) else None
+            if status != 200 or not blob:
+                METRICS.incr("router.migration_failures")
+                return False
+            status, imp, _ = self.replicas[dst].request(
+                "POST", "/kv/import", {"blob": blob}
+            )
+            pages = int(imp.get("pages") or 0) if isinstance(imp, dict) else 0
+            if status != 200 or pages <= 0:
+                # a refused import (no room) still means the session
+                # re-prefills on dst; count it so operators see churn
+                METRICS.incr("router.migration_failures")
+                return False
+        except Exception as exc:  # noqa: BLE001 — migration must never
+            # take down the forward it rides along with
+            log.debug("kv migration %s->%s failed: %r", src, dst, exc)
+            METRICS.incr("router.migration_failures")
+            return False
+        METRICS.incr("router.migrations")
+        FLIGHT.event("router_migrate", src=src, dst=dst, pages=pages)
+        log.info("migrated %d kv pages %s -> %s", pages, src, dst)
+        return True
+
+    def _maybe_migrate(self, key: str | None, rid: str, body: dict) -> None:
+        """Affinity-miss repair: the session remembers a different
+        replica than the one this request is about to land on (its home
+        is draining/ejected/busy) — try to bring the warm KV along so
+        the new home serves it from cache instead of re-prefilling."""
+        if key is None:
+            return
+        with self._lock:
+            prev = self._affinity.get(key)
+        if prev is None or prev == rid or prev not in self.replicas:
+            return
+        self._migrate(prev, rid, body)
+
+    def _handoff(self, key: str | None, rid: str, body: dict) -> None:
+        """Prefill→decode handoff (role split): after a prefill-heavy
+        replica served a request, push the prompt's KV to the
+        least-loaded decode-heavy replica and re-pin the session there —
+        follow-up turns hit a warm cache where decode capacity lives."""
+        if self._state[rid].role != "prefill-heavy":
+            return
+        cands = [r for r in self._candidates(exclude=(rid,))
+                 if self._state[r].role == "decode-heavy"]
+        if not cands:
+            return
+        dst = min(cands, key=lambda r: self._state[r].load())
+        if self._migrate(rid, dst, body):
+            self._remember(key, dst)
 
     @staticmethod
     def _deadline_budget(body: dict, headers: dict) -> float | None:
@@ -345,6 +477,7 @@ class Router:
                 "queue_depth": st.queue_depth,
                 "running": st.running,
                 "slots": st.slots,
+                "role": st.role,
             }
         return {"replicas": reps, "affinity_entries": len(self._affinity)}
 
@@ -354,6 +487,7 @@ class Router:
         t0 = time.monotonic()
         budget = self._deadline_budget(body, headers)
         key = self._affinity_key(body, headers)
+        pref = self._role_pref(body)
         tried: set[str] = set()
         last: tuple = (
             503,
@@ -371,13 +505,18 @@ class Router:
                         "message": "deadline expired before a replica "
                                    "answered",
                         "type": "timeout_error"}}
-            rid = self._pick(key, exclude=tried)
+            rid = self._pick(key, exclude=tried, role_pref=pref)
             if rid is None:
                 # force-probe the whole set once before giving up: a
                 # stale health cache must not shed a servable request
-                rid = self._pick(key, exclude=tried, force=True)
+                rid = self._pick(key, exclude=tried, force=True,
+                                 role_pref=pref)
             if rid is None:
                 break
+            if attempt == 0:
+                # the session's home replica fell out of rotation: bring
+                # its warm KV to wherever this request is about to land
+                self._maybe_migrate(key, rid, body)
             fwd = dict(headers or {})
             if remaining is not None:
                 fwd["X-FEI-Deadline-S"] = f"{remaining:.3f}"
@@ -419,6 +558,7 @@ class Router:
             st.fails = 0
             if status == 200:
                 self._remember(key, rid)
+                self._handoff(key, rid, body)
             return status, payload, dict(extra or {})
         METRICS.incr("router.sheds")
         status, payload, extra = last
@@ -437,6 +577,7 @@ class Router:
         t0 = time.monotonic()
         budget = self._deadline_budget(body, headers)
         key = self._affinity_key(body, headers)
+        pref = self._role_pref(body)
         tried: set[str] = set()
         last_err = {"message": "no usable replica",
                     "type": "overloaded_error"}
@@ -450,11 +591,14 @@ class Router:
                                            "replica answered",
                                 "type": "timeout_error"}
                     break
-            rid = self._pick(key, exclude=tried)
+            rid = self._pick(key, exclude=tried, role_pref=pref)
             if rid is None:
-                rid = self._pick(key, exclude=tried, force=True)
+                rid = self._pick(key, exclude=tried, force=True,
+                                 role_pref=pref)
             if rid is None:
                 break
+            if attempt == 0:
+                self._maybe_migrate(key, rid, body)
             fwd = dict(headers)
             if remaining is not None:
                 fwd["X-FEI-Deadline-S"] = f"{remaining:.3f}"
@@ -512,6 +656,9 @@ class Router:
             self._remember(key, rid)
             yield from buffered
             yield from gen
+            # stream finished: if a prefill-heavy replica served it,
+            # push the warm prefix to decode capacity for the next turn
+            self._handoff(key, rid, body)
             return
         METRICS.incr("router.sheds")
         yield (b"data: " + json.dumps({"error": last_err}).encode()

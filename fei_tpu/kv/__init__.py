@@ -1,0 +1,37 @@
+"""Tiered, mobile KV page store (ISSUE 15 / ROADMAP item 2).
+
+At fleet scale most sessions' KV is cold at any instant. Before this
+package an idle or preempted slot either pinned HBM pages or paid a full
+token-replay re-prefill at resume; the prefix cache only won when a
+session landed back on the replica that served it last. This package
+generalizes the snapshot machinery from "replay tokens" to "move bytes":
+
+- ``tier``    — host-RAM → disk page store with LRU demotion under
+  configurable byte budgets (``FEI_TPU_KV_TIER`` et al.), versioned +
+  checksummed entries, and async disk writes.
+- ``pagesio`` — gather/scatter between the paged HBM pool
+  (``engine/paged_cache.PagedKVCache``) and host numpy arrays; the
+  byte-exact transport both tiering and migration ride on. Works
+  unchanged on tp-sharded pools (page axis is replicated).
+- ``migrate`` — a session's prefix KV pages as one portable,
+  self-describing blob, so the fleet router can MOVE a hot session
+  between replicas (affinity miss, drain, prefill→decode handoff)
+  instead of re-prefilling from zero.
+
+The contract with the scheduler: every tier/migration path is an
+*optimization* with token replay as the always-correct fallback — a
+missing, corrupt, or mismatched entry must never wedge a slot, and a
+resume through streamed pages is byte-identical to one through replay.
+"""
+
+from fei_tpu.kv.tier import KVTierStore, PageEntry, TierConfig
+from fei_tpu.kv.pagesio import gather_pages, pool_fingerprint, scatter_pages
+
+__all__ = [
+    "KVTierStore",
+    "PageEntry",
+    "TierConfig",
+    "gather_pages",
+    "scatter_pages",
+    "pool_fingerprint",
+]

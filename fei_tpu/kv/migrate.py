@@ -1,0 +1,113 @@
+"""Cross-replica session migration: a prefix's KV pages as one blob.
+
+The router's prefix affinity only wins when a session lands back on the
+replica that served it last. Migration makes the warm state itself
+mobile: the source replica serializes the longest page-aligned cached
+prefix for a prompt (``export_blob``), the blob travels over the fleet
+control plane (``POST /kv/export`` → ``POST /kv/import``,
+ui/server.py), and the target scatters the pages into its own pool and
+registers them in its prefix cache (``import_entry``) — the next
+admission of that session prefix hits the cache instead of re-prefilling
+from zero. The same path hands a prefill-heavy replica's finished KV to
+a decode replica (role split, fleet/router.py).
+
+Contract:
+
+- The blob is the ``tier.pack_entry`` wire format with the prompt ids in
+  the header's ``extra`` — self-describing, versioned, checksummed.
+- Import REFUSES a geometry mismatch (``pool_fingerprint``): replicas
+  serving different models/dtypes/page sizes simply don't exchange KV.
+- Import is best-effort and never preempts: it takes only pages the
+  target pool can spare right now (after a prefix-cache eviction pass);
+  a refused import costs one re-prefill, exactly the pre-migration
+  world. Byte-identity of the decode stream is unaffected either way —
+  the pages a prefix-cache hit shares are bitwise the ones the source
+  wrote, and a miss replays tokens.
+
+Both entry points run on the scheduler loop thread (``run_ctl``): the
+pool is single-owner state and migration must not race a dispatch.
+"""
+
+from __future__ import annotations
+
+from fei_tpu.kv.pagesio import gather_pages, pool_fingerprint, scatter_pages
+from fei_tpu.kv.tier import PageEntry, pack_entry, unpack_entry
+from fei_tpu.utils.errors import KVTierError
+from fei_tpu.utils.logging import get_logger
+from fei_tpu.utils.metrics import METRICS
+
+log = get_logger("kv.migrate")
+
+# pseudo seq-id for in-flight imports: real slots are 0..B-1, spill keys
+# are request ids — this never collides with either
+_IMPORT_ID = -7777
+
+
+def export_blob(scheduler, prompt_ids: list[int]) -> bytes | None:
+    """The longest page-aligned cached prefix of ``prompt_ids`` as a
+    portable blob, or None when nothing is cached. Loop-thread only."""
+    pool = scheduler._pool
+    prefix = scheduler._prefix
+    if pool is None or prefix is None:
+        return None
+    pages = prefix.match(prompt_ids)
+    if not pages:
+        return None
+    alloc = scheduler.engine._allocator
+    alloc.take_ref(pages)  # pin against eviction while we gather
+    try:
+        arrays = gather_pages(pool, pages)
+    finally:
+        alloc.drop_ref(pages)
+    ps = pool.page_size
+    covered = len(pages) * ps
+    entry = PageEntry(
+        key="migrate",
+        n_tokens=covered,
+        page_size=ps,
+        fingerprint=pool_fingerprint(pool),
+        arrays=arrays,
+    )
+    blob = pack_entry(entry, extra={"prompt_ids": list(prompt_ids[:covered])})
+    METRICS.incr("kv.migrations_out")
+    METRICS.incr("kv.bytes_migrated", entry.nbytes)
+    return blob
+
+
+def import_blob(scheduler, blob: bytes) -> int:
+    """Scatter a migration blob into this replica's pool and register the
+    prefix. Returns how many pages landed (0 = refused: no room even
+    after prefix eviction — never preempts live work). Raises
+    ``KVTierError`` on a corrupt blob or a geometry mismatch.
+    Loop-thread only."""
+    entry, extra = unpack_entry(blob)
+    prompt_ids = [int(t) for t in extra.get("prompt_ids") or []]
+    if not prompt_ids or entry.n_pages == 0:
+        raise KVTierError("migration blob carries no prefix")
+    scheduler._ensure_pool()
+    pool = scheduler._pool
+    prefix = scheduler._prefix
+    if prefix is None:
+        raise KVTierError("target replica runs without a prefix cache")
+    want = pool_fingerprint(pool)
+    if entry.fingerprint != want:
+        raise KVTierError(
+            f"migration blob geometry {entry.fingerprint} does not match "
+            f"this pool {want}"
+        )
+    alloc = scheduler.engine._allocator
+    n = entry.n_pages
+    got = alloc.try_alloc(_IMPORT_ID, n)
+    if got is None:
+        prefix.evict_for(n)
+        got = alloc.try_alloc(_IMPORT_ID, n)
+    if got is None:
+        log.info("migration import refused: %d pages don't fit", n)
+        return 0
+    scheduler._pool = scatter_pages(pool, got, entry.arrays)
+    prefix.register(prompt_ids, got)
+    # the registry's refs keep the pages; drop the import's own claim
+    alloc.free(_IMPORT_ID)
+    METRICS.incr("kv.migrations_in")
+    METRICS.incr("kv.pages_migrated", n)
+    return n

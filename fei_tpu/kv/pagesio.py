@@ -1,0 +1,127 @@
+"""Byte-exact page movement between the paged HBM pool and host memory.
+
+``gather_pages`` pulls a set of pool pages to host numpy arrays
+(page-axis-first, so entries concatenate and slice per page);
+``scatter_pages`` writes them back into (possibly different) page ids of
+a (possibly different) pool. Together they are the transport both the
+RAM/disk tier and cross-replica migration ride on, so two invariants
+matter more than speed:
+
+- **Bitwise round-trip**: gather → scatter restores exactly the bytes
+  that were resident, for bf16 and int8(+scales) pools alike. Resume
+  correctness (greedy AND seeded byte-identity) reduces to this.
+- **Sharding transparency**: pools shard kv-heads over tp (PR 6:
+  ``parallel/sharding.paged_pool_specs``) while the page axis stays
+  replicated, so a gather assembles the full kv-head extent on host and
+  a scatter lands each shard's slice on its device — the jitted
+  programs below never mention the mesh and work for ms1 and tp2 both.
+
+Scatter donates the pool (the scheduler owns exactly one live pool
+value, same discipline as every dispatch); page-id lists are padded to
+a small multiple with the reserved null page 0 — inactive slots write
+there all the time and ``lengths`` masks it, so pad traffic is inert.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fei_tpu.engine.paged_cache import PagedKVCache
+
+# pad page-id lists to a multiple of this so the jit cache holds
+# O(max_pages / _PAD) program variants instead of one per page count
+_PAD = 8
+
+_ARRAY_FIELDS = ("k_pages", "v_pages", "k_scales", "v_scales")
+
+
+def pool_fingerprint(pool: PagedKVCache) -> dict:
+    """The per-page geometry a spilled entry must match to scatter back:
+    everything except the pool's total page count (two replicas with
+    different HBM budgets still exchange sessions)."""
+    L, _, K, ps, D = pool.k_pages.shape
+    return {
+        "layers": int(L),
+        "kv_heads": int(K),
+        "page_size": int(ps),
+        "head_dim": int(D),
+        "dtype": str(pool.k_pages.dtype),
+        "quantized": bool(pool.quantized),
+    }
+
+
+def _padded(pages: list[int]) -> list[int]:
+    n = len(pages)
+    m = -(-max(n, 1) // _PAD) * _PAD
+    return list(pages) + [0] * (m - n)
+
+
+@functools.partial(jax.jit, static_argnames=("quantized",))
+def _gather_fn(pool: PagedKVCache, ids: jnp.ndarray, quantized: bool):
+    out = {
+        # [L, P, K, ps, D] -take-> [L, n, ...] -> page-axis-first [n, L, ...]
+        "k_pages": jnp.moveaxis(jnp.take(pool.k_pages, ids, axis=1), 1, 0),
+        "v_pages": jnp.moveaxis(jnp.take(pool.v_pages, ids, axis=1), 1, 0),
+    }
+    if quantized:
+        out["k_scales"] = jnp.moveaxis(jnp.take(pool.k_scales, ids, axis=1), 1, 0)
+        out["v_scales"] = jnp.moveaxis(jnp.take(pool.v_scales, ids, axis=1), 1, 0)
+    return out
+
+
+def gather_pages(pool: PagedKVCache, pages: list[int]) -> dict[str, np.ndarray]:
+    """Pool pages -> host numpy, page-axis-first ([n, L, K, ps, D] /
+    scales [n, L, K, 1, ps]). The pool is read, never consumed."""
+    ids = jnp.asarray(_padded(pages), dtype=jnp.int32)
+    got = jax.device_get(_gather_fn(pool, ids, bool(pool.quantized)))
+    n = len(pages)
+    return {name: np.ascontiguousarray(arr[:n]) for name, arr in got.items()}
+
+
+@functools.partial(
+    jax.jit, static_argnames=("quantized",), donate_argnums=(0,)
+)
+def _scatter_fn(pool: PagedKVCache, ids: jnp.ndarray, vals: dict,
+                quantized: bool):
+    kw = {
+        "k_pages": pool.k_pages.at[:, ids].set(
+            jnp.moveaxis(vals["k_pages"], 0, 1).astype(pool.k_pages.dtype)
+        ),
+        "v_pages": pool.v_pages.at[:, ids].set(
+            jnp.moveaxis(vals["v_pages"], 0, 1).astype(pool.v_pages.dtype)
+        ),
+    }
+    if quantized:
+        kw["k_scales"] = pool.k_scales.at[:, ids].set(
+            jnp.moveaxis(vals["k_scales"], 0, 1).astype(pool.k_scales.dtype)
+        )
+        kw["v_scales"] = pool.v_scales.at[:, ids].set(
+            jnp.moveaxis(vals["v_scales"], 0, 1).astype(pool.v_scales.dtype)
+        )
+    return pool._replace(**kw)
+
+
+def scatter_pages(pool: PagedKVCache, pages: list[int],
+                  arrays: dict[str, np.ndarray]) -> PagedKVCache:
+    """Host page arrays -> pool pages. Donates (consumes) the pool and
+    returns the updated value, like every scheduler dispatch. ``arrays``
+    may hold MORE pages than ``pages`` asks for — the leading
+    ``len(pages)`` are written (a prefix-cache hit restores only the
+    suffix the slot doesn't already share)."""
+    n = len(pages)
+    padded = _padded(pages)
+    ids = jnp.asarray(padded, dtype=jnp.int32)
+    vals = {}
+    for name in _ARRAY_FIELDS:
+        if arrays.get(name) is None:
+            continue
+        a = np.asarray(arrays[name])[:n]
+        if n < len(padded):  # pad rows land on the inert null page 0
+            pad = np.zeros((len(padded) - n,) + a.shape[1:], dtype=a.dtype)
+            a = np.concatenate([a, pad], axis=0)
+        vals[name] = jnp.asarray(a)
+    return _scatter_fn(pool, ids, vals, bool(pool.quantized))

@@ -142,6 +142,24 @@ def chips_for_tag(tag: str | None) -> int:
         return 1
 
 
+def account_kv_transfer(direction: str, nbytes: int, dt_s: float) -> None:
+    """Bytes-moved accounting for the tiered KV store (kv/tier.py) and
+    migration: cumulative byte counters plus an achieved-GB/s gauge per
+    direction. ``direction`` is ``spilled`` (HBM→host on preemption) or
+    ``fetched`` (host→HBM on streamed resume). The gauge tells the
+    operator whether tier traffic is anywhere near the device-transfer
+    ceiling — spill/fetch time is pure resume-latency overhead."""
+    from fei_tpu.obs.metrics import METRICS
+
+    if direction not in ("spilled", "fetched"):
+        return
+    METRICS.incr(f"kv.bytes_{direction}", int(nbytes))
+    if dt_s > 0:
+        METRICS.gauge(
+            f"kv.{direction}_gbps", round(nbytes / dt_s / 1e9, 6)
+        )
+
+
 def account_dispatch(engine, n_steps: int, total_ctx: int, slots: int,
                      dt_s: float) -> None:
     """Live roofline accounting for one decode dispatch: update the

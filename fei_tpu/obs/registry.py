@@ -163,6 +163,65 @@ METRIC_REGISTRY: dict[str, tuple[str, str]] = {
     "router.rolling_restarts": ("counter",
                                 "Zero-downtime rolling restarts completed "
                                 "across the replica set."),
+    "router.role_routed": ("counter",
+                           "Requests steered by the replica role split "
+                           "(prefill-heavy vs decode/mixed preference "
+                           "narrowed the candidate set)."),
+    "router.migrations": ("counter",
+                          "KV sessions migrated between replicas over the "
+                          "/kv/export -> /kv/import control plane "
+                          "(affinity-miss repair and prefill->decode "
+                          "handoff)."),
+    "router.migration_failures": ("counter",
+                                  "KV migrations that failed or were "
+                                  "refused (target full, corrupt blob, "
+                                  "transport error); the session simply "
+                                  "re-prefills."),
+    "kv.spills": ("counter",
+                  "Preempted sequences whose KV pages were spilled to the "
+                  "host tier (the spill-before-preempt rung)."),
+    "kv.spill_failures": ("counter",
+                          "Spill attempts that failed (tier I/O, injected "
+                          "fault); the sequence still resumes via token "
+                          "replay."),
+    "kv.pages_spilled": ("counter",
+                         "KV pages copied HBM -> host tier at preemption."),
+    "kv.bytes_spilled": ("counter",
+                         "Bytes copied HBM -> host tier at preemption."),
+    "kv.fetches": ("counter",
+                   "Resumes served by streaming spilled pages back instead "
+                   "of re-prefilling."),
+    "kv.pages_restored": ("counter",
+                          "KV pages streamed host tier -> HBM at resume."),
+    "kv.bytes_fetched": ("counter",
+                         "Bytes streamed host tier -> HBM at resume."),
+    "kv.fetch_misses": ("counter",
+                        "Tier lookups that found no entry (evicted or "
+                        "never spilled); resume falls back to replay."),
+    "kv.fetch_corrupt": ("counter",
+                         "Tier entries rejected by checksum/format "
+                         "validation; the entry is discarded and resume "
+                         "falls back to replay."),
+    "kv.fetch_fallbacks": ("counter",
+                           "Resumes that fell back to token replay after "
+                           "the tier could not serve them (miss, corrupt, "
+                           "stale, or I/O error)."),
+    "kv.demotions": ("counter",
+                     "Tier entries demoted host RAM -> disk by the RAM "
+                     "budget (FEI_TPU_KV_RAM_BYTES)."),
+    "kv.evictions": ("counter",
+                     "Tier entries dropped entirely by budget pressure "
+                     "(no disk tier, or disk budget exceeded)."),
+    "kv.migrations_out": ("counter",
+                          "Sessions exported as migration blobs by this "
+                          "replica."),
+    "kv.migrations_in": ("counter",
+                         "Migration blobs imported into this replica's "
+                         "pool and prefix cache."),
+    "kv.pages_migrated": ("counter",
+                          "KV pages landed by migration imports."),
+    "kv.bytes_migrated": ("counter",
+                          "Bytes serialized into migration blobs."),
     "engine.compiles": ("counter",
                         "Jit program compilations observed (first build "
                         "per program signature — warmup cost)."),
@@ -223,6 +282,18 @@ METRIC_REGISTRY: dict[str, tuple[str, str]] = {
                                "Replicas the fleet router considers "
                                "routable (healthy, not draining, not "
                                "ejected)."),
+    "kv.tier_bytes_ram": ("gauge",
+                          "Bytes resident in the host-RAM KV tier."),
+    "kv.tier_bytes_disk": ("gauge",
+                           "Bytes resident in the on-disk KV tier."),
+    "kv.tier_entries": ("gauge",
+                        "Entries resident across both KV tiers."),
+    "kv.spilled_gbps": ("gauge",
+                        "Achieved HBM -> host throughput of the most "
+                        "recent spill (GB/s)."),
+    "kv.fetched_gbps": ("gauge",
+                        "Achieved host -> HBM throughput of the most "
+                        "recent streamed resume (GB/s)."),
     # --- spans (each also feeds a <name>_seconds histogram) -------------
     "prefill": ("span", "Full prefill dispatch."),
     "prefill_chunk": ("span", "One chunked-prefill chunk."),
@@ -242,6 +313,9 @@ METRIC_REGISTRY: dict[str, tuple[str, str]] = {
                              "blocking host sync; dispatch is pipelined)."),
     "spec_step": ("span", "One speculative decode step."),
     "grammar_fused_chunk": ("span", "One fused grammar-constrained chunk."),
+    "kv_spill": ("span", "One HBM -> host tier spill (gather + enqueue)."),
+    "kv_fetch": ("span", "One host tier -> HBM streamed resume (fetch + "
+                         "scatter)."),
     "agent.completion": ("span", "One LLM call from the assistant loop."),
     "provider.jax_local": ("span", "One local-engine provider call."),
     "tool.*": ("span", "One tool execution (per-tool family)."),

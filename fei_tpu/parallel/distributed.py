@@ -72,6 +72,7 @@ def initialize(
         if not any(m in os.environ for m in pod_markers):
             log.debug("no coordinator configured; staying single-host")
             return False
+    _enable_cpu_collectives()
     try:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
@@ -93,6 +94,30 @@ def initialize(
             return False
         log.error("jax.distributed.initialize failed: %s", exc)
         raise
+
+
+def _enable_cpu_collectives() -> None:
+    """Multi-process runs on the CPU backend (the two-rank rehearsal
+    tests, TPU-less dev boxes) need a real cross-process collectives
+    implementation: the default CPU client has none, so any computation
+    touching a multi-host sharding fails with "Multiprocess computations
+    aren't implemented on the CPU backend". jaxlib ships a gloo transport
+    behind ``jax_cpu_collectives_implementation`` — turn it on before the
+    backend is created when the platform is explicitly CPU. Guarded: the
+    flag does not exist on every jaxlib, and a created backend rejects
+    the update (both leave TPU/GPU paths untouched)."""
+    platform = (
+        os.environ.get("JAX_PLATFORMS", "")
+        or str(getattr(jax.config, "jax_platforms", "") or "")
+    )
+    if not platform.startswith("cpu"):
+        return
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception as exc:  # noqa: BLE001 — older jaxlib or a live
+        # backend: keep going, initialize() itself may still work for
+        # coordinator-only uses
+        log.debug("cpu collectives unavailable: %s", exc)
 
 
 def process_info() -> dict:

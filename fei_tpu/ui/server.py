@@ -17,8 +17,11 @@ loopback deployments typically run keyless.
 from __future__ import annotations
 
 import argparse
+import base64
+import binascii
 import hmac
 import json
+import os
 import tempfile
 import threading
 import time
@@ -40,6 +43,12 @@ from fei_tpu.utils.metrics import METRICS
 log = get_logger("ui.server")
 
 DEFAULT_PORT = 8188
+
+# fleet role split (docs/KV.md): a prefill-heavy replica takes the long
+# prompts, a decode-heavy one takes the token streams, mixed does both.
+# The router reads the role off /health and the migration path hands the
+# prefilled KV across (POST /kv/export -> POST /kv/import).
+REPLICA_ROLES = ("mixed", "prefill-heavy", "decode-heavy")
 
 
 def _content_text(content) -> str:
@@ -203,10 +212,16 @@ class ServeAPI:
     hermetic tests)."""
 
     def __init__(self, provider, model_name: str = "fei-tpu",
-                 api_key: str | None = None):
+                 api_key: str | None = None, role: str | None = None):
         self.provider = provider
         self.model_name = model_name
         self.api_key = api_key or ""
+        role = role or os.environ.get("FEI_TPU_REPLICA_ROLE", "") or "mixed"
+        if role not in REPLICA_ROLES:
+            raise ValueError(
+                f"replica role must be one of {REPLICA_ROLES}, got {role!r}"
+            )
+        self.role = role
         # one jax.profiler capture at a time; a second POST gets 409
         self._profile_lock = threading.Lock()
 
@@ -238,18 +253,18 @@ class ServeAPI:
         if route == "/health":
             mesh = self._mesh_tag()
             load = self._load_fields()
+            base = {"model": self.model_name, "mesh": mesh,
+                    "role": self.role, **load}
             if self._draining():
                 # a draining replica must leave the load-balancer rotation
                 # while its in-flight set finishes
-                return 503, {"status": "draining", "model": self.model_name,
-                             "mesh": mesh, **load}, {"Retry-After": "5"}
+                return 503, {"status": "draining", **base}, \
+                    {"Retry-After": "5"}
             if self._degraded():
                 # surface the crash-loop breaker so load balancers eject
                 # the replica instead of feeding it doomed requests
-                return 503, {"status": "degraded", "model": self.model_name,
-                             "mesh": mesh, **load}
-            return 200, {"status": "ok", "model": self.model_name,
-                         "mesh": mesh, **load}
+                return 503, {"status": "degraded", **base}
+            return 200, {"status": "ok", **base}
         if route == "/metrics" and method == "GET":
             # pre-auth like /health: scrapers don't carry bearer tokens
             return 200, METRICS.prometheus_text()
@@ -289,6 +304,12 @@ class ServeAPI:
             return self._chat(body, headers)
         if route == "/drain" and method == "POST":
             return self._drain(body)
+        # kv export/import stay routable while draining: migration-on-
+        # drain is exactly when a replica's warm KV must leave the ship
+        if route == "/kv/export" and method == "POST":
+            return self._kv_export(body)
+        if route == "/kv/import" and method == "POST":
+            return self._kv_import(body)
         if route == "/debug/profile" and method == "POST":
             return self._profile(body)
         return 404, {"error": {"message": f"no route {method} {route}",
@@ -418,6 +439,85 @@ class ServeAPI:
                 else eng._scheduler.drain_deadline_s
             ),
         }
+
+    # -- kv migration (fleet control plane) ---------------------------------
+
+    def _kv_scheduler(self):
+        eng = getattr(self.provider, "engine", None)
+        return getattr(eng, "_scheduler", None)
+
+    def _prompt_ids(self, body: dict) -> list[int]:
+        """Token ids for the request's prompt, rendered EXACTLY like a
+        real completion (same chat template, same system folding) so the
+        exported prefix is the one a later /v1/chat/completions on this
+        body would hit in the prefix cache."""
+        msgs, system = _from_openai_messages(body.get("messages") or [])
+        full = self.provider._messages_with_system(
+            msgs, system, _from_openai_tools(body.get("tools"))
+        )
+        eng = self.provider.engine
+        return list(eng.tokenizer.apply_chat_template(
+            full, add_generation_prompt=True
+        ))
+
+    def _kv_export(self, body: dict) -> tuple:
+        """Serialize the longest cached KV prefix for this prompt into a
+        portable blob (kv/migrate.py). 404 when nothing is cached — the
+        caller just re-prefills, exactly the pre-migration world."""
+        sched = self._kv_scheduler()
+        if sched is None or not hasattr(self.provider, "_messages_with_system"):
+            return 501, {"error": {
+                "message": "kv export needs an engine-backed provider",
+                "type": "invalid_request_error"}}
+        try:
+            ids = self._prompt_ids(body)
+        except (ValueError, KeyError, TypeError) as exc:
+            return 400, {"error": {"message": str(exc),
+                                   "type": "invalid_request_error"}}
+        try:
+            blob = sched.export_prefix(ids)
+        except Exception as exc:  # noqa: BLE001 — control plane must
+            # answer JSON, never drop the socket
+            log.warning("kv export failed: %r", exc)
+            return 500, {"error": {"message": f"{type(exc).__name__}: {exc}",
+                                   "type": "server_error"}}
+        if blob is None:
+            return 404, {"error": {
+                "message": "no cached prefix for this prompt",
+                "type": "invalid_request_error"}}
+        return 200, {"object": "kv.blob", "bytes": len(blob),
+                     "blob": base64.b64encode(blob).decode("ascii")}
+
+    def _kv_import(self, body: dict) -> tuple:
+        """Scatter a migration blob into this replica's pool. 422 for a
+        corrupt/mismatched blob (KVTierError); ``pages: 0`` when the pool
+        can't spare room — best-effort by contract, never preempts."""
+        from fei_tpu.utils.errors import KVTierError
+
+        sched = self._kv_scheduler()
+        if sched is None:
+            return 501, {"error": {
+                "message": "kv import needs an engine-backed provider",
+                "type": "invalid_request_error"}}
+        raw = body.get("blob")
+        if not isinstance(raw, str) or not raw:
+            return 400, {"error": {"message": "blob must be a base64 string",
+                                   "type": "invalid_request_error"}}
+        try:
+            blob = base64.b64decode(raw, validate=True)
+        except (binascii.Error, ValueError):
+            return 400, {"error": {"message": "blob is not valid base64",
+                                   "type": "invalid_request_error"}}
+        try:
+            pages = sched.import_prefix(blob)
+        except KVTierError as exc:
+            return 422, {"error": {"message": str(exc),
+                                   "type": "invalid_request_error"}}
+        except Exception as exc:  # noqa: BLE001
+            log.warning("kv import failed: %r", exc)
+            return 500, {"error": {"message": f"{type(exc).__name__}: {exc}",
+                                   "type": "server_error"}}
+        return 200, {"object": "kv.import", "pages": int(pages)}
 
     @staticmethod
     def _retry_after(exc) -> dict:
@@ -638,8 +738,6 @@ class ServingServer:
 
 
 def main(argv: list[str] | None = None) -> int:
-    import os
-
     p = argparse.ArgumentParser(
         description="OpenAI-compatible serving endpoint over the TPU engine"
     )

