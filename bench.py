@@ -676,6 +676,108 @@ def bench_paged(model: str, n_tokens: int) -> int:
     )
 
 
+def bench_ragged(model: str, n_tokens: int) -> int:
+    """A/B of the ragged merged dispatch: FEI_TPU_ATTENTION=paged (legacy
+    solo chunk + solo scan programs) vs =ragged (one merged program per
+    overlap iteration), at batch 1 and batch 8, median-of-3 per arm with
+    per-run rates attached. The flag is read at scheduler construction,
+    so each arm builds its own engine; a small prefill chunk keeps
+    admissions chunked (the regime the merge exists for). Each rung also
+    greedy-compares one stream across arms — an A/B whose arms decode
+    different tokens measures nothing."""
+    import threading
+
+    from fei_tpu.engine import GenerationConfig
+
+    prev_attn = os.environ.get("FEI_TPU_ATTENTION")
+    results: dict[str, dict] = {}
+    gen = GenerationConfig(
+        max_new_tokens=n_tokens, temperature=0.0, ignore_eos=True
+    )
+    try:
+        for streams in (1, 8):
+            engines: dict[str, tuple] = {}
+            ref_tokens = None
+            for arm in ("paged", "ragged"):
+                os.environ["FEI_TPU_ATTENTION"] = arm
+                engine = _make_engine(
+                    model, max_seq_len=2048, paged=True,
+                    batch_size=streams, page_size=64,
+                )
+                # the 128-token bench prompt must actually chunk (2 here)
+                # or no overlap iterations occur and both arms measure the
+                # same program; single-slot engines still never merge
+                engine.scheduler.prefill_chunk = 64
+                prompt = _prompt(engine)
+                # parity probe doubles as the single-stream warm-up
+                toks = list(engine.scheduler.stream(prompt, gen))
+                if ref_tokens is None:
+                    ref_tokens = toks
+                elif toks != ref_tokens:
+                    raise RuntimeError(
+                        f"ragged A/B arms diverged at {streams} stream(s): "
+                        f"{toks[:8]} vs {ref_tokens[:8]}"
+                    )
+                engines[arm] = (engine, prompt)
+
+            def fan(engine, prompt, streams=streams):
+                counts = [0] * streams
+                errors: list = []
+
+                def consume(i):
+                    try:
+                        counts[i] = sum(
+                            1 for _ in engine.scheduler.stream(prompt, gen)
+                        )
+                    except BaseException as exc:  # noqa: BLE001 — re-raised
+                        errors.append(exc)
+
+                threads = [
+                    threading.Thread(target=consume, args=(i,))
+                    for i in range(streams)
+                ]
+                t0 = time.time()
+                [t.start() for t in threads]
+                [t.join() for t in threads]
+                if errors:
+                    raise errors[0]
+                return sum(counts), time.time() - t0
+
+            # untimed full-fan round per arm first: compiles every
+            # merged-program signature (one per armed-slot count) before
+            # the clock starts
+            for arm in ("paged", "ragged"):
+                fan(*engines[arm])
+            rates: dict[str, list[float]] = {"paged": [], "ragged": []}
+            for run in range(3):
+                # interleaved: machine drift lands on both arms equally
+                for arm in ("paged", "ragged"):
+                    n_toks, dt = fan(*engines[arm])
+                    rates[arm].append(n_toks / dt)
+            for arm in ("paged", "ragged"):
+                engines[arm][0].scheduler.close()
+                med = sorted(rates[arm])[len(rates[arm]) // 2]
+                log(f"bench: ragged A/B arm={arm} streams={streams}: "
+                    f"median {med:.1f} tok/s (runs {rates[arm]})")
+                results[f"{arm}_{streams}s"] = {
+                    "tok_s": round(med, 2),
+                    "runs_tok_s": [round(r, 2) for r in rates[arm]],
+                }
+            engines.clear()
+    finally:
+        if prev_attn is None:
+            os.environ.pop("FEI_TPU_ATTENTION", None)
+        else:
+            os.environ["FEI_TPU_ATTENTION"] = prev_attn
+    rc = 0
+    for key, r in results.items():
+        rc = _emit(
+            f"{_tag(model)}_ragged_ab_{key}_agg_tok_s_per_chip",
+            r["tok_s"], extra={"runs_tok_s": r["runs_tok_s"]},
+        )
+    return rc
+
+
 def bench_moe(model: str, n_tokens: int) -> int:
     os.environ.setdefault("FEI_TPU_ROUTED_MOE", "auto")
     return bench_decode(model, n_tokens)
@@ -1435,6 +1537,8 @@ def main() -> int:
         return bench_prefill(model, n_tokens)
     if suite == "paged":
         return bench_paged(model, n_tokens)
+    if suite == "ragged":
+        return bench_ragged(model, n_tokens)
     if suite == "sharded":
         return bench_sharded(model, n_tokens)
     if suite == "moe":

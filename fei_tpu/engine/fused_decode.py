@@ -82,6 +82,23 @@ def resolve_chunk(gen_chunk: int = 0) -> int:
         return DEFAULT_CHUNK
 
 
+def resolve_kernel_loop() -> int:
+    """Kernel-looping factor for the fused free-phase scan
+    (``FEI_TPU_KERNEL_LOOP``, default 1 = off).
+
+    A factor of L multiplies the scanned depth of each dispatched chunk:
+    one compiled program covers ``chunk × L`` decode steps — per-layer
+    and per-step synchronization hoisted out of L× more of the decode
+    stream, at the cost of L× the speculative overshoot past a stop
+    (bounded: the on-device stop early-exit makes post-stop iterations
+    exact no-ops, and the host truncates delivery at stops/budget, so
+    the token stream is bit-identical to factor 1)."""
+    try:
+        return max(1, int(os.environ.get("FEI_TPU_KERNEL_LOOP", "1")))
+    except ValueError:
+        return 1
+
+
 def build_fused_decode(fwd: Callable, cfg, gen, n_steps: int) -> Callable:
     """Compile the N-step free-decode scan for one sampling config.
 
@@ -166,7 +183,10 @@ class ChunkDecoder:
         self._done = jnp.zeros((self._token.shape[0],), dtype=jnp.bool_)
         self._stop_ids = jnp.asarray(sorted(stops), dtype=jnp.int32)
         self._fed = fed
-        self._chunk = max(1, int(chunk))
+        # kernel looping: each dispatch scans chunk × loop steps — the
+        # host-visible chunking (yield granularity, rollback points) is
+        # untouched; only the compiled program covers more of the stream
+        self._chunk = max(1, int(chunk)) * resolve_kernel_loop()
         self._want = want
         self._sched = 0
         self._slots_left = engine.max_seq_len - fed - 1
@@ -179,6 +199,10 @@ class ChunkDecoder:
                 n = self._chunk if self._slots_left >= self._chunk else self._slots_left
                 fused = self._engine._free_fused_fn(self._gen, n)
                 METRICS.incr("engine.decode_dispatches")
+                METRICS.gauge(
+                    "engine.kernel_loop_depth",
+                    n * self._engine.cfg.num_layers,
+                )
                 t0 = time.perf_counter()
                 toks, self._cache, self._token, self._rng, self._done, rngs = fused(
                     self._engine.params, self._cache, self._token, self._rng,

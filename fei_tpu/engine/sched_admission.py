@@ -477,6 +477,13 @@ class AdmissionMixin:
 
     def _admit_chunk(self) -> None:
         """Run ONE prefill chunk of the in-flight chunked admission."""
+        if self._pending_chunk is not None:
+            # a deferred chunk survived a full loop iteration without any
+            # decode dispatch consuming it (e.g. every armed slot was
+            # reaped right after it was stashed): its solo dispatch IS
+            # this call's one-chunk budget
+            self._flush_pending_chunk()
+            return
         st = self._admitting
         seq = st["seq"]
         if seq.finished:  # reaped by _reap_cancelled already
@@ -548,63 +555,26 @@ class AdmissionMixin:
             toks[0, : hi - lo] = prompt[lo:hi]
             final = hi >= n_pre
         if st.get("mode") == "paged":
-            try:
-                t0 = time.perf_counter()
-                with METRICS.span("prefill_chunk", jax_trace=True):
-                    fn = self._paged_chunk_fn(C, final)
-                    out = fn(
-                        eng.params, self._pool, jnp.asarray(toks),
-                        jnp.asarray(st["row"][None]),
-                        jnp.asarray([lo], dtype=jnp.int32),
-                        jnp.int32(n - 1 - lo),
-                    )
-                    t_issue = time.perf_counter()
-                    if final:
-                        last_logits, self._pool = out
-                        last_logits.block_until_ready()
-                    else:
-                        self._pool = out
-                FLIGHT.dispatch(
-                    "dispatch.prefill_chunk", t0, t_issue,
-                    time.perf_counter(), rid=seq.rid,
-                    mesh=mesh_tag(eng.mesh), slot=st["slot"],
-                    tokens=hi - lo, paged=True,
+            if (
+                self.ragged_attention
+                and not seq.generated
+                and any(
+                    s is not None and not s.prefilling for s in self._slots
                 )
-            except Exception as exc:  # noqa: BLE001
-                first = lo == st["prefix"] * eng.page_size
-                if first and self._pool_intact():
-                    # first chunk, pool untouched (e.g. Mosaic rejected the
-                    # chunk tile on-chip): release the slot and requeue the
-                    # request at the FRONT — it re-admits through the
-                    # normal path with the native route disabled, shared
-                    # prefix pages surviving on their registry refs
-                    log.warning(
-                        "paged-native prefill failed (%r); falling back to "
-                        "the dense-staging path", exc,
-                    )
-                    self.paged_native_prefill = False
-                    METRICS.incr("scheduler.paged_prefill_disabled")
-                    self._admitting = None
-                    eng._allocator.free(st["slot"])
-                    self._slots[st["slot"]] = None
-                    seq.slot = -1
-                    seq.prefilling = False
-                    seq.prefix_match = None  # pins dropped: re-probe
-                    seq.lazy = False  # re-decided at the next admission
-                    with self._lock:
-                        self._waiting.appendleft(seq)
-                    return
-                raise
-            st["pos"] = hi
-            if not final or hi < n:
-                # more prompt chunks — or, on a resume, the generated
-                # suffix still has to replay; decode steps interleave
+            ):
+                # DEFER: _dispatch_steps merges this chunk with the
+                # iteration's decode scan as ONE ragged dispatch (the
+                # weights stream once for both). If no scan runs, the
+                # _step_active flush dispatches it solo — the admission
+                # still advances exactly one chunk per loop iteration.
+                # Resumes stay solo: their replay/prompt-walk chunks are
+                # the byte-identity contract (see the branch above).
+                self._pending_chunk = {
+                    "st": st, "toks": toks, "lo": lo, "hi": hi,
+                    "final": final, "ntok": n,
+                }
                 return
-            self._admitting = None
-            self._complete_admission_paged(
-                seq, st["slot"], last_logits, st["row"],
-                prefix_pages=st.get("prefix", 0),
-            )
+            self._dispatch_chunk_solo(st, seq, toks, lo, hi, final, n)
             return
         t0 = time.perf_counter()
         with METRICS.span("prefill_chunk", jax_trace=True):
@@ -628,6 +598,113 @@ class AdmissionMixin:
             prefix_pages=st.get("prefix", 0),
         )
 
+
+    def _dispatch_chunk_solo(
+        self, st: dict, seq: _Seq, toks: np.ndarray, lo: int, hi: int,
+        final: bool, n: int,
+    ) -> None:
+        """Dispatch one paged-native prefill chunk as its OWN program
+        (the legacy shape, and the fallback when a deferred chunk found
+        no decode scan to merge with)."""
+        eng = self.engine
+        C = toks.shape[1]
+        try:
+            t0 = time.perf_counter()
+            with METRICS.span("prefill_chunk", jax_trace=True):
+                fn = self._paged_chunk_fn(C, final)
+                out = fn(
+                    eng.params, self._pool, jnp.asarray(toks),
+                    jnp.asarray(st["row"][None]),
+                    jnp.asarray([lo], dtype=jnp.int32),
+                    jnp.int32(n - 1 - lo),
+                )
+                t_issue = time.perf_counter()
+                if final:
+                    last_logits, self._pool = out
+                    last_logits.block_until_ready()
+                else:
+                    self._pool = out
+            FLIGHT.dispatch(
+                "dispatch.prefill_chunk", t0, t_issue,
+                time.perf_counter(), rid=seq.rid,
+                mesh=mesh_tag(eng.mesh), slot=st["slot"],
+                tokens=hi - lo, paged=True,
+            )
+        except Exception as exc:  # noqa: BLE001
+            first = lo == st["prefix"] * eng.page_size
+            if first and self._pool_intact():
+                # first chunk, pool untouched (e.g. Mosaic rejected the
+                # chunk tile on-chip): release the slot and requeue the
+                # request at the FRONT — it re-admits through the
+                # normal path with the native route disabled, shared
+                # prefix pages surviving on their registry refs
+                log.warning(
+                    "paged-native prefill failed (%r); falling back to "
+                    "the dense-staging path", exc,
+                )
+                self.paged_native_prefill = False
+                METRICS.incr("scheduler.paged_prefill_disabled")
+                self._admitting = None
+                eng._allocator.free(st["slot"])
+                self._slots[st["slot"]] = None
+                seq.slot = -1
+                seq.prefilling = False
+                seq.prefix_match = None  # pins dropped: re-probe
+                seq.lazy = False  # re-decided at the next admission
+                with self._lock:
+                    self._waiting.appendleft(seq)
+                return
+            raise
+        st["pos"] = hi
+        if not final or hi < n:
+            # more prompt chunks — or, on a resume, the generated
+            # suffix still has to replay; decode steps interleave
+            return
+        self._admitting = None
+        self._complete_admission_paged(
+            seq, st["slot"], last_logits, st["row"],
+            prefix_pages=st.get("prefix", 0),
+        )
+
+    def _flush_pending_chunk(self) -> None:
+        """Solo-dispatch a deferred prefill chunk that no decode dispatch
+        consumed. The merged ragged dispatch is opportunistic; admission
+        progress is not — every loop iteration that stashed a chunk must
+        see it dispatched (merged or solo) before the next chunk."""
+        pc = self._pending_chunk
+        if pc is None:
+            return
+        self._pending_chunk = None
+        st = pc["st"]
+        if st is not self._admitting:
+            return  # admission aborted/completed elsewhere: drop it
+        seq = st["seq"]
+        if seq.finished or seq.cancelled:
+            return  # the next _admit_chunk call reaps it
+        try:
+            self._dispatch_chunk_solo(
+                st, seq, pc["toks"], pc["lo"], pc["hi"], pc["final"],
+                pc["ntok"],
+            )
+        except BaseException as exc:  # noqa: BLE001
+            # same containment as _admit_ready's wrapper around
+            # _admit_chunk — the flush runs outside it
+            self._abort_admission(seq, st["slot"], exc)
+
+    def _finish_merged_chunk(self, pc: dict, chunk_logits) -> None:
+        """Host bookkeeping for a chunk that rode a merged ragged
+        dispatch: advance the admission cursor and, on the final chunk,
+        run the exact completion tail the solo path runs (sample the
+        first token from the chunk's LM-head logits, arm the slot)."""
+        st = pc["st"]
+        st["pos"] = pc["hi"]
+        if not pc["final"]:
+            return
+        self._admitting = None
+        self._complete_admission_paged(
+            st["seq"], st["slot"], chunk_logits, st["row"],
+            prefix_pages=st.get("prefix", 0),
+        )
 
     def _paged_chunk_fn(self, C: int, final: bool):
         """Compiled paged-native prefill chunk: forward [1, C] tokens

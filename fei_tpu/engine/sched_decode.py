@@ -28,6 +28,46 @@ from fei_tpu.utils.metrics import METRICS
 log = get_logger("scheduler")
 
 
+def _make_sampler(grammared: bool, masked: bool):
+    """The ONE on-device sampling tail every scheduler decode step runs:
+    grammar DFA mask, optional host mask, per-slot key split, dynamic
+    sampling, DFA state advance. Shared by ``_multi_fn``'s scan body and
+    ``_ragged_fn``'s merged first step so the two programs cannot drift —
+    the merged path's sampling chain stays bit-identical to the solo
+    scan's by construction."""
+    from fei_tpu.engine.grammar import feasible_mask
+
+    def sample(logits, keys, temps, topks, topps, minps,
+               gstates=None, gremain=None, table=None, mind=None,
+               mask=None):
+        if grammared:
+            # per-slot DFA mask, entirely on device: slots with
+            # gstate < 0 (free/unconstrained) pass through. Budget
+            # feasibility is the shared rule (grammar.feasible_mask,
+            # same as the dense scan).
+            use = gstates >= 0
+            srow = table[jnp.maximum(gstates, 0)]  # [B, V]
+            gmask = feasible_mask(srow, mind, gremain, xp=jnp)
+            gmask = jnp.where(use[:, None], gmask, True)
+            logits = jnp.where(gmask, logits, -jnp.inf)
+        if masked:
+            logits = jnp.where(mask, logits, -jnp.inf)
+        outs = jax.vmap(jax.random.split)(keys)  # [B, 2, 2]
+        new_keys, subs = outs[:, 0], outs[:, 1]
+        nxt = sample_logits_dynamic(
+            logits, subs, temps, topks, topps, minps
+        )
+        if grammared:
+            nstate = jnp.take_along_axis(
+                srow, nxt[:, None], axis=1
+            )[:, 0].astype(jnp.int32)
+            gstates = jnp.where(use, nstate, gstates)
+            gremain = jnp.where(use, gremain - 1, gremain)
+        return nxt, new_keys, gstates, gremain
+
+    return sample
+
+
 class DecodeMixin:
     """Batched decode stepping: spec, single, and multi-step dispatches."""
 
@@ -164,6 +204,16 @@ class DecodeMixin:
 
 
     def _step_active(self) -> None:
+        self._step_active_impl()
+        # a deferred admission chunk not consumed by this iteration's
+        # decode dispatch (masked single-step path, spec path, all armed
+        # slots finished mid-iteration, or the ragged program disarmed
+        # itself) still makes progress NOW — bounded-stall admission is a
+        # guarantee, not a fast path. Deliberately not in a finally:
+        # after a device error the loop's handler owns the pool.
+        self._flush_pending_chunk()
+
+    def _step_active_impl(self) -> None:
         eng = self.engine
         B, V = self.B, eng.cfg.vocab_size
         if self._maybe_spec_step():
@@ -420,7 +470,17 @@ class DecodeMixin:
                 gstates[b] = s.gstate
                 gremain[b] = s.budget - len(s.generated)
                 grammared = True
-        step = self._multi_fn(n, grammared, masked=mask is not None)
+        pc = None
+        if mask is None and self._pending_chunk is not None:
+            # merge the deferred admission chunk into THIS dispatch: one
+            # ragged program serves the prefill chunk AND the decode scan
+            # (host masks must be re-evaluated between steps, so the
+            # masked single-step path never merges — the flush dispatches
+            # the chunk solo right after)
+            pc = self._pending_chunk
+            self._pending_chunk = None
+            if pc["st"] is not self._admitting:
+                pc = None  # admission moved on (cancelled/aborted): drop
         args = [eng.params, self._pool, jnp.asarray(tokens), self._keys,
                 jnp.asarray(temps), jnp.asarray(topks), jnp.asarray(topps),
                 jnp.asarray(minps)]
@@ -435,27 +495,90 @@ class DecodeMixin:
         METRICS.incr("scheduler.decode_steps", n)
         METRICS.incr("scheduler.decode_slot_steps", len(active) * n)
         METRICS.gauge("scheduler.batch_slots_active", len(active))
+        chunk_logits = None
+        merged = False
         t0 = time.perf_counter()
-        with METRICS.span("decode_step"):
-            nxt, self._step_keys, self._pool, self._keys = step(*args, **kw)
-            t_issue = time.perf_counter()
-            out = np.asarray(nxt)  # host sync inside the span
+        if pc is not None:
+            step = self._ragged_fn(
+                n, pc["toks"].shape[1], pc["final"], grammared
+            )
+            rargs = args[:2] + [
+                jnp.asarray(pc["toks"]),
+                jnp.asarray(pc["st"]["row"][None]),
+                jnp.asarray([pc["lo"]], dtype=jnp.int32),
+                jnp.int32(pc["ntok"] - 1 - pc["lo"]),
+            ] + args[2:]
+            try:
+                with METRICS.span("decode_step"):
+                    res = step(*rargs, **kw)
+                    if pc["final"]:
+                        (chunk_logits, nxt, self._step_keys, self._pool,
+                         self._keys) = res
+                    else:
+                        nxt, self._step_keys, self._pool, self._keys = res
+                    t_issue = time.perf_counter()
+                    out = np.asarray(nxt)  # host sync inside the span
+                merged = True
+            except Exception as exc:  # noqa: BLE001
+                if not self._pool_intact():
+                    raise
+                # trace/compile-stage failure (e.g. Mosaic rejected the
+                # ragged tile on-chip): the donated pool is untouched, so
+                # disarm the merged path for the engine's lifetime,
+                # re-stash the chunk for a solo dispatch (the
+                # _step_active flush), and run the legacy scan
+                log.warning(
+                    "ragged merged dispatch failed (%r); falling back to "
+                    "the legacy FEI_TPU_ATTENTION=paged programs", exc,
+                )
+                self.ragged_attention = False
+                METRICS.incr("scheduler.ragged_disabled")
+                self._pending_chunk = pc
+                pc = None
+                t0 = time.perf_counter()
+        if not merged:
+            step = self._multi_fn(n, grammared, masked=mask is not None)
+            with METRICS.span("decode_step"):
+                nxt, self._step_keys, self._pool, self._keys = step(*args, **kw)
+                t_issue = time.perf_counter()
+                out = np.asarray(nxt)  # host sync inside the span
         t1 = time.perf_counter()
         self._record_collective_time(t1 - t0)
         METRICS.timing("dispatch_issue", t_issue - t0)
         METRICS.timing("dispatch_sync", t1 - t_issue)
+        extra = {}
+        if merged:
+            # NO separate "dispatch.prefill_chunk" record for a merged
+            # chunk — that count dropping under overlap IS the measured
+            # dispatch reduction (pinned in tests/test_ragged_attention)
+            extra = {
+                "ragged": True, "chunk_tokens": pc["hi"] - pc["lo"],
+                "chunk_rid": pc["st"]["seq"].rid,
+            }
         FLIGHT.dispatch(
             "dispatch.step", t0, t_issue, t1,
             rids=[s.rid for _, s in active], mesh=mesh_tag(eng.mesh),
-            n_steps=n, slots=len(active),
+            n_steps=n, slots=len(active), **extra,
         )
-        costmodel.account_dispatch(
-            eng, n,
-            sum(len(s.prompt_ids) + len(s.generated) for _, s in active),
-            len(active), t1 - t0,
-        )
+        ctx = sum(len(s.prompt_ids) + len(s.generated) for _, s in active)
+        if merged:
+            METRICS.incr("engine.ragged_dispatches")
+            METRICS.gauge("engine.kernel_loop_depth", n * eng.cfg.num_layers)
+            costmodel.account_ragged_dispatch(
+                eng, n, ctx, len(active),
+                pc["hi"] - pc["lo"], pc["lo"], t1 - t0,
+            )
+        else:
+            costmodel.account_dispatch(eng, n, ctx, len(active), t1 - t0)
         for _, s in active:
             s.shield = False  # survived a dispatch: victimizable again
+        if merged:
+            st = pc["st"]
+            try:
+                self._finish_merged_chunk(pc, chunk_logits)
+            except BaseException as exc:  # noqa: BLE001
+                # same containment as _admit_ready's solo-chunk wrapper
+                self._abort_admission(st["seq"], st["slot"], exc)
         return out
 
     def _record_collective_time(self, dt: float) -> None:
@@ -487,40 +610,24 @@ class DecodeMixin:
             def multi(params, pool, tokens, keys, temps, topks, topps,
                       minps, gstates=None, gremain=None, table=None,
                       mind=None, mask=None):
-                from fei_tpu.engine.grammar import feasible_mask
+                sampler = _make_sampler(grammared, masked)
 
                 def body(carry, _):
                     if grammared:
                         pool, tokens, keys, gstates, gremain = carry
                     else:
                         pool, tokens, keys = carry
+                        gstates = gremain = None
                     logits, pool = forward_paged(
                         params, cfg, tokens, pool, kernel_mesh=mesh
                     )
                     logits = logits[:, -1, :]
-                    if grammared:
-                        # per-slot DFA mask, entirely on device: slots with
-                        # gstate < 0 (free/unconstrained) pass through.
-                        # Budget feasibility is the shared rule
-                        # (grammar.feasible_mask, same as the dense scan).
-                        use = gstates >= 0
-                        srow = table[jnp.maximum(gstates, 0)]  # [B, V]
-                        gmask = feasible_mask(srow, mind, gremain, xp=jnp)
-                        gmask = jnp.where(use[:, None], gmask, True)
-                        logits = jnp.where(gmask, logits, -jnp.inf)
-                    if masked:
-                        logits = jnp.where(mask, logits, -jnp.inf)
-                    outs = jax.vmap(jax.random.split)(keys)  # [B, 2, 2]
-                    new_keys, subs = outs[:, 0], outs[:, 1]
-                    nxt = sample_logits_dynamic(
-                        logits, subs, temps, topks, topps, minps
+                    nxt, new_keys, gstates, gremain = sampler(
+                        logits, keys, temps, topks, topps, minps,
+                        gstates=gstates, gremain=gremain, table=table,
+                        mind=mind, mask=mask,
                     )
                     if grammared:
-                        nstate = jnp.take_along_axis(
-                            srow, nxt[:, None], axis=1
-                        )[:, 0].astype(jnp.int32)
-                        gstates = jnp.where(use, nstate, gstates)
-                        gremain = jnp.where(use, gremain - 1, gremain)
                         carry = (pool, nxt[:, None], new_keys, gstates, gremain)
                     else:
                         carry = (pool, nxt[:, None], new_keys)
@@ -541,6 +648,93 @@ class DecodeMixin:
 
             self._step_jit[key] = self.engine._compiles.wrap(
                 "sched.multi", key, jax.jit(multi, donate_argnums=(1,))
+            )
+        return self._step_jit[key]
+
+    def _ragged_fn(self, n_steps: int, C: int, final: bool, grammared: bool):
+        """The MERGED program: one ragged dispatch serves a prefill chunk
+        and an ``n_steps`` decode scan. Step 1 runs through
+        ``forward_paged_merged`` (chunk + decode attention in one ragged
+        kernel invocation per layer); steps 2..n are the exact
+        ``_multi_fn`` scan body. Sampling goes through the shared
+        ``_make_sampler`` tail, and step 1 splits the [B] key batch once —
+        precisely what the solo scan's first step does — so the sampled
+        streams are bit-identical to the unmerged programs. ``final``
+        additionally projects the chunk's last prompt position through the
+        LM head, same epilogue as ``_paged_chunk_fn``."""
+        key = ("ragged", n_steps, C, final, grammared)
+        if key not in self._step_jit:
+            cfg = self.engine.cfg
+            mesh = self.engine.mesh
+            rows = self.ragged_rows
+            from fei_tpu.models.llama import _logits, forward_paged_merged
+
+            def ragged(params, pool, ctoks, crow, cpos, clast, tokens,
+                       keys, temps, topks, topps, minps, gstates=None,
+                       gremain=None, table=None, mind=None):
+                sampler = _make_sampler(grammared, False)
+                chunk_hidden, logits, pool = forward_paged_merged(
+                    params, cfg, ctoks, crow, cpos, tokens, pool,
+                    kernel_mesh=mesh, rows=rows,
+                )
+                logits = logits[:, -1, :]
+                nxt, new_keys, gstates, gremain = sampler(
+                    logits, keys, temps, topks, topps, minps,
+                    gstates=gstates, gremain=gremain, table=table,
+                    mind=mind,
+                )
+                toks = nxt[None]
+                step_keys = new_keys[None]
+                if n_steps > 1:
+                    def body(carry, _):
+                        if grammared:
+                            pool, tokens, keys, gstates, gremain = carry
+                        else:
+                            pool, tokens, keys = carry
+                            gstates = gremain = None
+                        logits, pool = forward_paged(
+                            params, cfg, tokens, pool, kernel_mesh=mesh
+                        )
+                        logits = logits[:, -1, :]
+                        nxt, new_keys, gstates, gremain = sampler(
+                            logits, keys, temps, topks, topps, minps,
+                            gstates=gstates, gremain=gremain, table=table,
+                            mind=mind,
+                        )
+                        if grammared:
+                            carry = (
+                                pool, nxt[:, None], new_keys, gstates,
+                                gremain,
+                            )
+                        else:
+                            carry = (pool, nxt[:, None], new_keys)
+                        return carry, (nxt, new_keys)
+
+                    init = (
+                        (pool, nxt[:, None], new_keys, gstates, gremain)
+                        if grammared else (pool, nxt[:, None], new_keys)
+                    )
+                    carry, (toks_r, keys_r) = jax.lax.scan(
+                        body, init, None, length=n_steps - 1
+                    )
+                    pool, keys_out = carry[0], carry[2]
+                    toks = jnp.concatenate([toks, toks_r], axis=0)
+                    step_keys = jnp.concatenate([step_keys, keys_r], axis=0)
+                else:
+                    keys_out = new_keys
+                out = (jnp.swapaxes(toks, 0, 1), step_keys, pool, keys_out)
+                if not final:
+                    return out
+                h_last = jax.lax.dynamic_slice_in_dim(
+                    chunk_hidden, clast, 1, axis=1
+                )  # [1, 1, H] — already final-normed
+                chunk_logits = _logits(
+                    h_last, params, cfg, kernel_mesh=mesh
+                )[:, 0]
+                return (chunk_logits,) + out
+
+            self._step_jit[key] = self.engine._compiles.wrap(
+                "sched.ragged", key, jax.jit(ragged, donate_argnums=(1,))
             )
         return self._step_jit[key]
 

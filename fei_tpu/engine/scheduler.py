@@ -195,6 +195,27 @@ class PagedScheduler(AdmissionMixin, DecodeMixin, ConstraintMixin):
         self.spec_ngram = int(_os.environ.get("FEI_TPU_SPEC_NGRAM", "3"))
         self.spec_draft_len = int(_os.environ.get("FEI_TPU_SPEC_DRAFT", "8"))
         self.speculate = _os.environ.get("FEI_TPU_SPECULATE", "0") == "1"
+        # ragged merged dispatch: a paged-native prefill chunk defers one
+        # loop iteration and rides the decode scan as ONE program — the
+        # ragged paged-attention kernel serves the chunk's rows and the
+        # decode rows in a single invocation per layer, so the weights
+        # stream once for both (ops/pallas/ragged_paged_attention.py).
+        # FEI_TPU_ATTENTION=paged keeps the legacy two-program shape
+        # (solo chunk + solo scan) for A/B and rollback; token streams
+        # are bit-identical either way.
+        attn = _os.environ.get("FEI_TPU_ATTENTION", "ragged")
+        if attn not in ("ragged", "paged"):
+            raise EngineError(
+                f"unknown FEI_TPU_ATTENTION {attn!r} (ragged | paged)"
+            )
+        self.ragged_attention = attn == "ragged"
+        # query-row tile of the ragged kernel: the chunk splits into
+        # groups of this many positions (decode rows pad up to it). Any
+        # value is bitwise-equivalent; 8 keeps the f32 row scratch small
+        self.ragged_rows = max(
+            1, int(_os.environ.get("FEI_TPU_RAGGED_ROWS", "8"))
+        )
+        self._pending_chunk: dict | None = None  # deferred merge chunk
         # paged-NATIVE chunked prefill: admission chunks write K/V straight
         # into pool pages and attend via the multi-query block kernel
         # through a one-slot pool view — no dense staging cache (bucket ×

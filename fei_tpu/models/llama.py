@@ -680,6 +680,193 @@ def forward_paged_block(
     return out, new_cache
 
 
+def forward_paged_merged(
+    params: dict,
+    cfg: ModelConfig,
+    chunk_toks: jnp.ndarray,  # [1, C] int32 — one prefill chunk
+    chunk_row: jnp.ndarray,  # [1, max_pages] admitting slot's table row
+    chunk_pos: jnp.ndarray,  # [1] int32 — chunk's absolute start position
+    dec_tokens: jnp.ndarray,  # [B, 1] int32 — one decode token per slot
+    cache,  # PagedKVCache under the LIVE table/lengths
+    routed_moe: bool = False,
+    moe_mesh=None,
+    kernel_mesh=None,
+    rows: int = 8,
+) -> tuple[jnp.ndarray, jnp.ndarray, object]:
+    """One ragged dispatch serves a prefill chunk AND a decode step.
+
+    The legacy scheduler iteration issues two programs — the chunk body
+    (``forward_paged_block`` through a one-slot view) and the decode step
+    (``forward_paged``) — streaming the weights twice. Here the two run
+    through ONE layer scan: per layer the chunk's [1, C] tokens and the
+    decode batch's [B, 1] tokens each keep their own legacy-shaped
+    projections/norms/MLP matmuls (bitwise the ops the solo programs run),
+    and only the two attention invocations merge into a single ragged
+    kernel call over ``B + ceil(C/rows)`` virtual rows — decode rows at
+    q_len=1 against the live table, the chunk split into ``rows``-position
+    groups against the admitting slot's row. Splitting is bitwise-neutral:
+    each query row's online softmax walks the same pages in the same
+    order, and pages beyond a row's causal limit are exact no-ops for it
+    (masked scores underflow to p=0 with correction=1 once any live page
+    has been seen — the property the legacy block kernel's per-row limits
+    already rely on).
+
+    Writes commute: chunk K/V lands in the admitting slot's pages (its
+    LIVE row is still zeroed, so no decode row reads them), decode K/V in
+    each armed slot's own pages. Returns ``(chunk_hidden [1, C, H]
+    final-normed, dec_logits [B, 1, V], cache with lengths += 1)`` —
+    chunk-side lengths are host-tracked (``st["pos"]``), as on the solo
+    path.
+    """
+    from fei_tpu.engine.paged_cache import write_token_kv
+    from fei_tpu.ops.pallas.ragged_paged_attention import (
+        ragged_paged_attention,
+        ragged_paged_attention_sharded,
+    )
+
+    B, _ = dec_tokens.shape
+    _, C = chunk_toks.shape
+    K, d, Hq = cfg.num_kv_heads, cfg.head_dim_, cfg.num_heads
+    R = rows
+    nG = -(-C // R)  # chunk groups of R query positions
+    Cp = nG * R
+    Bv = B + nG
+    chunk_positions = chunk_pos[:, None] + jnp.arange(C, dtype=jnp.int32)[None, :]
+    dec_positions = cache.lengths[:, None]
+    max_pos = cache.block_table.shape[1] * cache.page_size
+    cos, sin = compute_rope_freqs(cfg.rope_dim_, max_pos, cfg.rope_theta)
+    sharded = kernel_mesh is not None and (
+        kernel_mesh.shape.get("tp", 1) > 1
+        or kernel_mesh.shape.get("dp", 1) > 1
+    )
+    win = cfg.sliding_window or 0
+
+    # per-virtual-row metadata: decode rows then chunk groups
+    btv = jnp.concatenate(
+        [cache.block_table, jnp.tile(chunk_row, (nG, 1))], axis=0
+    )
+    group_starts = chunk_pos + jnp.arange(nG, dtype=jnp.int32) * R
+    limits = jnp.concatenate([cache.lengths + 1, group_starts + 1])
+    q_lens = jnp.concatenate([
+        jnp.ones((B,), dtype=jnp.int32),
+        jnp.clip(C - jnp.arange(nG, dtype=jnp.int32) * R, 0, R),
+    ])
+    # mode=1 rows re-run the online update at single-query shapes so the
+    # decode side rounds exactly like the standalone qt=1 program
+    modes = jnp.concatenate([
+        jnp.ones((B,), dtype=jnp.int32),
+        jnp.zeros((nG,), dtype=jnp.int32),
+    ])
+
+    kv_int8 = cache.k_scales is not None
+    dtype = model_dtype(params) if kv_int8 else cache.k_pages.dtype
+    xc = embed_tokens(params, cfg, chunk_toks, dtype)  # [1, C, h]
+    xd = embed_tokens(params, cfg, dec_tokens, dtype)  # [B, 1, h]
+
+    def body(carry, layer_inputs):
+        xc, xd = carry
+        if kv_int8:
+            lp, kp, vp, ksc, vsc = layer_inputs
+        else:
+            lp, kp, vp = layer_inputs
+            ksc = vsc = None
+        yc = _norm(xc, lp["attn_norm"], cfg, b=lp.get("attn_norm_b"))
+        qc, kc, vc = qkv_proj(lp, yc, Hq, K, d, kernel_mesh=kernel_mesh)
+        qc = _rope(qc, cos, sin, chunk_positions, cfg.rope_dim_)
+        kc = _rope(kc, cos, sin, chunk_positions, cfg.rope_dim_)
+        yd = _norm(xd, lp["attn_norm"], cfg, b=lp.get("attn_norm_b"))
+        qd, kd, vd = qkv_proj(lp, yd, Hq, K, d, kernel_mesh=kernel_mesh)
+        qd = _rope(qd, cos, sin, dec_positions, cfg.rope_dim_)
+        kd = _rope(kd, cos, sin, dec_positions, cfg.rope_dim_)
+
+        # chunk writes first, then the decode row writes — page-disjoint,
+        # so the order is free (mirrors the solo programs' chunk-first)
+        for i in range(C):
+            written = write_token_kv(
+                kp, vp, kc[:, i], vc[:, i], chunk_row, chunk_pos + i,
+                k_scales=ksc, v_scales=vsc,
+            )
+            if kv_int8:
+                kp, vp, ksc, vsc = written
+            else:
+                kp, vp = written
+        written = write_token_kv(
+            kp, vp, kd[:, 0], vd[:, 0], cache.block_table, cache.lengths,
+            k_scales=ksc, v_scales=vsc,
+        )
+        if kv_int8:
+            kp, vp, ksc, vsc = written
+        else:
+            kp, vp = written
+
+        # ONE ragged invocation for both sides: decode rows padded to the
+        # R-row tile (pad rows compute garbage never read), chunk padded
+        # to a whole number of groups
+        qv = jnp.concatenate([
+            jnp.pad(qd, ((0, 0), (0, R - 1), (0, 0), (0, 0))),
+            jnp.pad(qc, ((0, 0), (0, Cp - C), (0, 0), (0, 0)))
+            .reshape(nG, R, Hq, d),
+        ], axis=0)  # [Bv, R, Hq, d]
+        if sharded:
+            av = ragged_paged_attention_sharded(
+                qv, kp, vp, btv, limits, q_lens, modes, kernel_mesh,
+                axis_name="tp", k_scales=ksc, v_scales=vsc, window=win,
+            )
+        else:
+            av = ragged_paged_attention(
+                qv, kp, vp, btv, limits, q_lens, modes,
+                k_scales=ksc, v_scales=vsc, window=win,
+            )
+        dec_attn = av[:B, :1]  # [B, 1, Hq, d]
+        chunk_attn = av[B:].reshape(1, Cp, Hq, d)[:, :C]
+
+        out = (kp, vp, ksc, vsc) if kv_int8 else (kp, vp)
+
+        def tail(x, y, attn, T, nB):
+            o = mm(attn.reshape(nB, T, Hq * d), lp["wo"])
+            if "bo" in lp:
+                o = o + lp["bo"]
+            if cfg.parallel_block:  # Phi: x + attn(ln x) + mlp(ln x)
+                mlp_out = (
+                    _moe(cfg, y, lp, routed_moe, moe_mesh) if cfg.is_moe
+                    else _mlp_dense(cfg, y, lp, kernel_mesh)
+                )
+                return x + o + mlp_out
+            x = x + o
+            y2 = _norm(x, lp["mlp_norm"], cfg, b=lp.get("mlp_norm_b"))
+            if cfg.is_moe:
+                mlp_out = _moe(cfg, y2, lp, routed_moe, moe_mesh)
+            else:
+                mlp_out = _mlp_dense(cfg, y2, lp, kernel_mesh)
+            return x + mlp_out
+
+        xc = tail(xc, yc, chunk_attn, C, 1)
+        xd = tail(xd, yd, dec_attn, 1, B)
+        return (xc, xd), out
+
+    if kv_int8:
+        xs = (
+            params["layers"], cache.k_pages, cache.v_pages,
+            cache.k_scales, cache.v_scales,
+        )
+        (xc, xd), (new_k, new_v, new_ks, new_vs) = jax.lax.scan(
+            body, (xc, xd), xs
+        )
+    else:
+        xs = (params["layers"], cache.k_pages, cache.v_pages)
+        (xc, xd), (new_k, new_v) = jax.lax.scan(body, (xc, xd), xs)
+        new_ks = new_vs = None
+
+    xc = _norm(xc, params["final_norm"], cfg, b=params.get("final_norm_b"))
+    xd = _norm(xd, params["final_norm"], cfg, b=params.get("final_norm_b"))
+    dec_logits = _logits(xd, params, cfg, kernel_mesh=kernel_mesh)
+    new_cache = cache._replace(
+        k_pages=new_k, v_pages=new_v, lengths=cache.lengths + 1,
+        k_scales=new_ks, v_scales=new_vs,
+    )
+    return xc, dec_logits, new_cache
+
+
 def forward_train(
     params: dict,
     cfg: ModelConfig,

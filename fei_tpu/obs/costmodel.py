@@ -114,6 +114,24 @@ def dispatch_bytes(engine, n_steps: int, total_ctx: int, slots: int) -> int:
     return int(max(1, n_steps) * per_step)
 
 
+def ragged_dispatch_bytes(
+    engine, n_steps: int, total_ctx: int, slots: int,
+    chunk_tokens: int, chunk_ctx: int,
+) -> int:
+    """HBM bytes of one MERGED ragged dispatch: a decode scan that also
+    carries a prefill chunk (``chunk_tokens`` positions starting at
+    absolute context ``chunk_ctx``) in its first step. The decode side is
+    exactly ``dispatch_bytes``; the chunk adds NO extra weight stream —
+    that is the point of the merge, the first step's weight read serves
+    both sides — only its own K/V traffic: one row write per chunk token
+    plus the page reads its causal attention walks (history up to the
+    chunk's end, ≈ ``chunk_ctx + chunk_tokens`` rows; the intra-chunk
+    triangle is second-order at this resolution)."""
+    kv_row = kv_row_bytes(engine)
+    chunk = kv_row * (chunk_ctx + 2 * chunk_tokens)
+    return dispatch_bytes(engine, n_steps, total_ctx, slots) + int(chunk)
+
+
 def roofline_fraction(bytes_streamed: int, dt_s: float,
                       n_chips: int = 1) -> float:
     """Fraction of the aggregate HBM roofline achieved: estimated bytes
@@ -175,12 +193,44 @@ def account_dispatch(engine, n_steps: int, total_ctx: int, slots: int,
     for ax in AXES:
         n_chips *= axis_size(mesh, ax)
     est = dispatch_bytes(engine, n_steps, total_ctx, slots)
+    _roofline_gauges(engine, est, n_steps * slots, dt_s, n_chips)
+
+
+def account_ragged_dispatch(
+    engine, n_steps: int, total_ctx: int, slots: int,
+    chunk_tokens: int, chunk_ctx: int, dt_s: float,
+) -> None:
+    """Roofline accounting for one MERGED ragged dispatch (decode scan +
+    prefill chunk in one program). The byte estimate credits the chunk's
+    K/V traffic but NOT a second weight stream (see
+    ``ragged_dispatch_bytes``), and tok_s_per_chip keeps counting decode
+    tokens only — prefill positions are not served tokens, so the gauge
+    stays comparable across the merged and legacy paths."""
+    from fei_tpu.parallel.mesh import AXES, axis_size
+
+    if dt_s <= 0:
+        return
+    mesh = getattr(engine, "mesh", None)
+    n_chips = 1
+    for ax in AXES:
+        n_chips *= axis_size(mesh, ax)
+    est = ragged_dispatch_bytes(
+        engine, n_steps, total_ctx, slots, chunk_tokens, chunk_ctx
+    )
+    _roofline_gauges(engine, est, n_steps * slots, dt_s, n_chips)
+
+
+def _roofline_gauges(engine, est_bytes: int, tokens: int, dt_s: float,
+                     n_chips: int) -> None:
+    from fei_tpu.obs.metrics import METRICS
+
     # 9 decimals: a tiny CPU model's frac is O(1e-7) and must not round
     # to a flat zero; production fractions are O(0.1) and unaffected
     METRICS.gauge(
-        "roofline.frac", round(roofline_fraction(est, dt_s, n_chips), 9)
+        "roofline.frac",
+        round(roofline_fraction(est_bytes, dt_s, n_chips), 9),
     )
     METRICS.gauge(
         "roofline.tok_s_per_chip",
-        round(n_steps * slots / dt_s / max(1, n_chips), 3),
+        round(tokens / dt_s / max(1, n_chips), 3),
     )

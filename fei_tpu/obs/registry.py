@@ -65,6 +65,9 @@ METRIC_REGISTRY: dict[str, tuple[str, str]] = {
                                     "slots)."),
     "scheduler.paged_prefill_disabled": ("counter",
                                          "Paged-native prefill fallbacks."),
+    "scheduler.ragged_disabled": (
+        "counter", "Merged ragged dispatches disarmed after an on-chip "
+                   "failure (legacy two-program path takes over)."),
     "scheduler.spec_steps": ("counter", "Speculative decode steps."),
     "scheduler.spec_accepted": ("counter",
                                 "Speculative tokens accepted."),
@@ -97,6 +100,14 @@ METRIC_REGISTRY: dict[str, tuple[str, str]] = {
                                  "chunks or per-token steps) — per-token "
                                  "regressions show as a jump vs tokens "
                                  "emitted."),
+    "engine.ragged_dispatches": (
+        "counter", "Merged ragged dispatches: decode scans that also "
+                   "carried a prefill chunk in one program (one weight "
+                   "stream for both)."),
+    "engine.kernel_loop_depth": (
+        "gauge", "Scanned depth of the last decode dispatch in layer "
+                 "programs (steps x layers collapsed into one "
+                 "dispatch)."),
     "engine.grammar_trigger_suffix_rejected": (
         "counter", "Grammar trigger suffixes rejected (engine path)."),
     "engine.grammar_budget_too_small": (

@@ -5,7 +5,10 @@ fei/core/assistant.py:524-530); these are the greenfield TPU-native hot ops:
 
 - flash_attention: blockwise causal attention for prefill — O(T) memory,
   online softmax, MXU-shaped [block_q, block_k] score tiles.
-- paged_attention: ragged paged-KV decode attention over a block table.
+- paged_attention: paged-KV decode attention over a block table (legacy
+  fixed-query-block programs, kept behind FEI_TPU_ATTENTION=paged).
+- ragged_paged_attention: mixed prefill+decode rows — per-row
+  (limit, q_len) metadata — in ONE invocation over the paged pool.
 
 Every kernel runs in interpret mode on CPU (the hermetic test mesh) and
 compiled on TPU; the XLA-native fei_tpu.ops.attention is the correctness
@@ -14,5 +17,6 @@ oracle for both.
 
 from fei_tpu.ops.pallas.flash_attention import flash_attention
 from fei_tpu.ops.pallas.paged_attention import paged_attention
+from fei_tpu.ops.pallas.ragged_paged_attention import ragged_paged_attention
 
-__all__ = ["flash_attention", "paged_attention"]
+__all__ = ["flash_attention", "paged_attention", "ragged_paged_attention"]

@@ -73,12 +73,31 @@ stage() {
 # attach-ok / attach-failed / attach-hung verdict in the pipeline log,
 # and FEI_TPU_ATTACH_DIAG exported so EVERY bench stage's JSON line
 # carries the diagnosis. The probe is abandoned on timeout, never killed
-# (the lease rule above); the pipeline continues either way — bench
-# stages have their own labeled CPU fallback.
+# (the lease rule above).
 . "$(dirname "$0")/attach_probe.sh"
-attach_probe "${ATTACH_TIMEOUT:-300}" || true
+attach_probe "${ATTACH_TIMEOUT:-300}"
+ATTACH_RC=$?
 echo "[$(date -u +%H:%M:%S)] attach watchdog: ${FEI_TPU_ATTACH_DIAG}" \
   >> "$OUT/pipeline.log"
+
+# attach-hung (rc 2) means the backend accepted the connection and then
+# wedged mid-init. A bench run now would silently re-measure on the
+# labeled CPU fallback and ship a number that measures nothing (every
+# bench since r3 did exactly that) — ROADMAP says diagnose, not route
+# around. run_bench REFUSES the perf stages loudly, diagnosis attached,
+# so the stage shows rc=1 + the probe verdict instead of a bogus tok/s.
+# Correctness stages still run: their platform pin fails fast on its
+# own, and a per-stage rc is exactly the attribution we want.
+run_bench() {
+  if [ "${ATTACH_RC:-0}" -eq 2 ]; then
+    echo "bench REFUSED: attach-hung — ${FEI_TPU_ATTACH_DIAG}"
+    echo "the backend wedged mid-attach; a run now would CPU-fallback and"
+    echo "measure nothing. Clear the wedged lease / restart the backend,"
+    echo "then re-run this pipeline."
+    return 1
+  fi
+  "$@"
+}
 
 # 0. tunnel latency + single-jit init characterization (session-local
 # probe; logs to stdout, which stage() captures)
@@ -96,6 +115,13 @@ fi
 stage kernels env FEI_TPU_TEST_PLATFORM=tpu python -m pytest \
   tests/test_pallas_kernels.py tests/test_kv_quant.py \
   tests/test_sliding_window.py -q --timeout 120
+
+# 0a2. ragged paged attention: merged prefill+decode kernel parity vs the
+# legacy two-program path (token identity greedy+seeded, mixed-batch
+# shapes, dispatch-count identities, preempt->resume through the merged
+# path) — MUST be green before any ragged A/B number means anything
+stage ragged env FEI_TPU_TEST_PLATFORM=tpu python -m pytest \
+  tests/test_ragged_attention.py -q --timeout 600
 
 # 0b. flash-attention backward on-chip (jax.grad through the pallas kernels)
 stage flash_grad env FEI_TPU_TEST_PLATFORM=tpu python -m pytest \
@@ -153,7 +179,7 @@ stage tenancy_tests env FEI_TPU_TEST_PLATFORM=tpu python -m pytest \
   tests/test_tenancy.py -q --timeout 600
 stage fleet_tests env FEI_TPU_TEST_PLATFORM=tpu python -m pytest \
   tests/test_fleet.py -q --timeout 600
-stage bench_fleet env FEI_TPU_BENCH_SUITE=fleet FEI_TPU_BENCH_SESSIONS=24 \
+stage bench_fleet run_bench env FEI_TPU_BENCH_SUITE=fleet FEI_TPU_BENCH_SESSIONS=24 \
   FEI_TPU_BENCH_MAX_WAIT_S=300 python -u bench.py
 
 # 0d1c. tiered KV store ON-CHIP (docs/KV.md): spill/restore
@@ -174,7 +200,7 @@ stage chaos_kv_fetch_corrupt env FEI_TPU_FLEET_SMOKE_MODE=kv \
   FEI_TPU_FAULT="kv.fetch:corrupt:2" python -u scripts/fleet_smoke.py
 stage chaos_kv_fetch_hang env FEI_TPU_FLEET_SMOKE_MODE=kv \
   FEI_TPU_FAULT="kv.fetch:hang:1" python -u scripts/fleet_smoke.py
-stage bench_kvtier env FEI_TPU_BENCH_SUITE=kvtier \
+stage bench_kvtier run_bench env FEI_TPU_BENCH_SUITE=kvtier \
   FEI_TPU_BENCH_MAX_WAIT_S=300 python -u bench.py
 
 # 0d2. flight-recorder timeline smoke ON-CHIP: mixed workload (concurrent
@@ -209,7 +235,7 @@ if [ "${NDEV:-1}" -ge 2 ]; then
     FEI_TPU_FAULT="decode.dispatch:device:1" python -m pytest \
     tests/test_faults.py::test_env_fault_sweep_recovers -q --timeout 300
 fi
-stage bench_sharded env FEI_TPU_BENCH_SUITE=sharded \
+stage bench_sharded run_bench env FEI_TPU_BENCH_SUITE=sharded \
   FEI_TPU_BENCH_MAX_WAIT_S=300 python -u bench.py
 
 # ---- TIER 1: the gate + everything never measured on-chip (r3 stages 6b-9
@@ -217,20 +243,20 @@ stage bench_sharded env FEI_TPU_BENCH_SUITE=sharded \
 
 # 1. THE GATE: 8B int8 decode bench (the driver's default metric).
 # Re-run first: it refreshes onchip_state.json's headline slot.
-stage bench_8b_int8 env FEI_TPU_BENCH_MAX_WAIT_S=300 python -u bench.py
+stage bench_8b_int8 run_bench env FEI_TPU_BENCH_MAX_WAIT_S=300 python -u bench.py
 
 # 2. agent e2e: `fei --message` through the whole stack at GATE scale —
 # the literal BASELINE metric (tok/s + TTFT for fei --message)
-stage bench_agent_8b env FEI_TPU_BENCH_SUITE=agent \
+stage bench_agent_8b run_bench env FEI_TPU_BENCH_SUITE=agent \
   FEI_TPU_BENCH_MODEL=llama3-8b FEI_TPU_BENCH_QUANT=int8 \
   FEI_TPU_BENCH_MAX_WAIT_S=300 python -u bench.py
 
 # 3. config #3's serving shape at gate scale: 8B int8 weights + int8 KV
 # pool, 4 then 8 concurrent streams (VERDICT r3 #4)
-stage bench_8b_paged_4s env FEI_TPU_BENCH_SUITE=paged \
+stage bench_8b_paged_4s run_bench env FEI_TPU_BENCH_SUITE=paged \
   FEI_TPU_BENCH_MODEL=llama3-8b FEI_TPU_BENCH_QUANT=int8 \
   FEI_TPU_BENCH_KV_QUANT=int8 FEI_TPU_BENCH_MAX_WAIT_S=300 python -u bench.py
-stage bench_8b_paged_8s env FEI_TPU_BENCH_SUITE=paged \
+stage bench_8b_paged_8s run_bench env FEI_TPU_BENCH_SUITE=paged \
   FEI_TPU_BENCH_MODEL=llama3-8b FEI_TPU_BENCH_QUANT=int8 \
   FEI_TPU_BENCH_KV_QUANT=int8 FEI_TPU_BENCH_STREAMS=8 \
   FEI_TPU_BENCH_MAX_WAIT_S=300 python -u bench.py
@@ -241,34 +267,40 @@ stage bench_8b_paged_8s env FEI_TPU_BENCH_SUITE=paged \
 stage int4_tests env FEI_TPU_TEST_PLATFORM=tpu python -m pytest \
   tests/test_int4.py -q --timeout 120
 stage int4_diag python -u scripts/int4_diag.py
-stage bench_8b_int4 env FEI_TPU_BENCH_QUANT=int4 FEI_TPU_BENCH_MAX_WAIT_S=300 \
+stage bench_8b_int4 run_bench env FEI_TPU_BENCH_QUANT=int4 FEI_TPU_BENCH_MAX_WAIT_S=300 \
   python -u bench.py
 
 # 5. prefill latency at agent-loop prompt length (8B int8, 4096 tokens)
-stage bench_prefill env FEI_TPU_BENCH_SUITE=prefill \
+stage bench_prefill run_bench env FEI_TPU_BENCH_SUITE=prefill \
   FEI_TPU_BENCH_MODEL=llama3-8b FEI_TPU_BENCH_QUANT=int8 \
   FEI_TPU_BENCH_MAX_WAIT_S=300 python -u bench.py
 
 # 5b. phi-2 decode (round 4): the ONE perf number in the reference's docs
 # is a MOCKED "Phi-2 at 67 tokens/s" (HOW_FEI_NETWORK_WORKS.md:60-75);
 # 2.7B bf16 = 5.6 GB fits the chip — measure the real thing
-stage bench_phi2 env FEI_TPU_BENCH_MODEL=phi-2 FEI_TPU_BENCH_QUANT= \
+stage bench_phi2 run_bench env FEI_TPU_BENCH_MODEL=phi-2 FEI_TPU_BENCH_QUANT= \
   FEI_TPU_BENCH_MAX_WAIT_S=300 python -u bench.py
 
 # ---- TIER 2: effect-size A/Bs for the dispatch-amortization features
 # (VERDICT r3 #6) — 1B so each run is fast; the variable is the flag. ----
 
 # 6. multistep scheduler scan: 1 (off) vs 8 (default)
-stage ab_multistep_1 env FEI_TPU_BENCH_SUITE=paged FEI_TPU_SCHED_MULTISTEP=1 \
+stage ab_multistep_1 run_bench env FEI_TPU_BENCH_SUITE=paged FEI_TPU_SCHED_MULTISTEP=1 \
   FEI_TPU_BENCH_MAX_WAIT_S=300 python -u bench.py
-stage ab_multistep_8 env FEI_TPU_BENCH_SUITE=paged FEI_TPU_SCHED_MULTISTEP=8 \
+stage ab_multistep_8 run_bench env FEI_TPU_BENCH_SUITE=paged FEI_TPU_SCHED_MULTISTEP=8 \
+  FEI_TPU_BENCH_MAX_WAIT_S=300 python -u bench.py
+
+# 6b. ragged merged dispatch A/B: legacy two-program path vs the ragged
+# one-dispatch-per-iteration path, batch 1 and batch 8 (suite runs both
+# arms itself, median-of-3 per arm, runs_tok_s attached)
+stage bench_ragged run_bench env FEI_TPU_BENCH_SUITE=ragged \
   FEI_TPU_BENCH_MAX_WAIT_S=300 python -u bench.py
 
 # 7. paged prompt-lookup speculation: off vs on (single stream — the
 # speculation path's case)
-stage ab_spec_off env FEI_TPU_BENCH_SUITE=paged FEI_TPU_BENCH_STREAMS=1 \
+stage ab_spec_off run_bench env FEI_TPU_BENCH_SUITE=paged FEI_TPU_BENCH_STREAMS=1 \
   FEI_TPU_SPECULATE=0 FEI_TPU_BENCH_MAX_WAIT_S=300 python -u bench.py
-stage ab_spec_on env FEI_TPU_BENCH_SUITE=paged FEI_TPU_BENCH_STREAMS=1 \
+stage ab_spec_on run_bench env FEI_TPU_BENCH_SUITE=paged FEI_TPU_BENCH_STREAMS=1 \
   FEI_TPU_SPECULATE=1 FEI_TPU_BENCH_MAX_WAIT_S=300 python -u bench.py
 
 # ---- TIER 3: re-validation of suites already green on-chip in round 3
@@ -276,11 +308,11 @@ stage ab_spec_on env FEI_TPU_BENCH_SUITE=paged FEI_TPU_BENCH_STREAMS=1 \
 # tier 0. ----
 
 # 8. 1B paged + moe re-validation (r3 numbers: 175.7 / 188.4 / 141.9)
-stage bench_paged env FEI_TPU_BENCH_SUITE=paged FEI_TPU_BENCH_MAX_WAIT_S=300 \
+stage bench_paged run_bench env FEI_TPU_BENCH_SUITE=paged FEI_TPU_BENCH_MAX_WAIT_S=300 \
   python -u bench.py
-stage bench_paged_kv8 env FEI_TPU_BENCH_SUITE=paged FEI_TPU_BENCH_KV_QUANT=int8 \
+stage bench_paged_kv8 run_bench env FEI_TPU_BENCH_SUITE=paged FEI_TPU_BENCH_KV_QUANT=int8 \
   FEI_TPU_BENCH_MAX_WAIT_S=300 python -u bench.py
-stage bench_moe env FEI_TPU_BENCH_SUITE=moe FEI_TPU_BENCH_MAX_WAIT_S=300 \
+stage bench_moe run_bench env FEI_TPU_BENCH_SUITE=moe FEI_TPU_BENCH_MAX_WAIT_S=300 \
   python -u bench.py
 
 echo "=== pipeline done $(date -u) ===" >> "$OUT/pipeline.log"

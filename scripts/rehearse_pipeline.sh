@@ -100,6 +100,14 @@ stage ab_spec_off --json -- env FEI_TPU_BENCH_SUITE=paged \
 stage ab_spec_on --json -- env FEI_TPU_BENCH_SUITE=paged \
   FEI_TPU_BENCH_STREAMS=1 FEI_TPU_SPECULATE=1 python -u bench.py
 
+# ragged merged dispatch: the parity + dispatch-count suite runs FOR
+# REAL here (hermetic, tiny models), then the A/B bench arm — legacy
+# two-program vs ragged one-dispatch, batch 1 + batch 8 in one suite
+stage ragged -- python -m pytest tests/test_ragged_attention.py -q \
+  --timeout 600
+stage bench_ragged --json -- env FEI_TPU_BENCH_SUITE=ragged \
+  python -u bench.py
+
 # --- round-5 follow-up stages (scripts/onchip_extra.sh) -------------------
 stage chunk64 --json -- env FEI_TPU_BENCH_CHUNK=64 python -u bench.py
 stage chunk128 --json -- env FEI_TPU_BENCH_CHUNK=128 python -u bench.py

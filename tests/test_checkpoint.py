@@ -96,7 +96,10 @@ class TestTrainResume:
         d = str(tmp_path / "resume")
         save_checkpoint(d, 2, p2, opt_state=s2)
         out = restore_checkpoint(d, target={"params": p2, "opt_state": s2})
-        p3, s3 = out["params"], out["opt_state"]
+        # copy before the donating step_fn: restored arrays can be backed
+        # by tensorstore-owned buffers, and donating those intermittently
+        # segfaults when XLA reuses the storage in place
+        p3, s3 = dup(out["params"]), dup(out["opt_state"])
         for _ in range(2):
             p3, s3, loss_b = step_fn(p3, s3, tokens)
 
