@@ -1360,6 +1360,190 @@ def bench_kvtier(model: str, n_tokens: int) -> int:
                  total_tokens / dt, unit="tok/s", extra=extra)
 
 
+def bench_kvcdn(model: str, n_tokens: int) -> int:
+    """Content-addressed prefix store (KV CDN) flops-saved + pre-warm.
+
+    Phase 1 — dedup under a Zipfian session mix: FEI_TPU_BENCH_SESSIONS
+    (default 28) sessions sample a handful of shared "repo" contexts with
+    Zipf weights (a few hot repos dominate, a long tail barely repeats) —
+    the shape fleet prompt traffic actually has. Headline is the prefill
+    flops saved: 1 - scheduler.prefill_tokens / total prompt tokens
+    (prefix + content-addressed hits are tokens never re-prefilled), with
+    ``kv.dedup_ratio`` — N sessions per hot repo, ONE tier copy — riding
+    first-class in the extras.
+
+    Phase 2 — rolling-restart TTFT: a two-replica fleet serves a hot
+    prompt, then rolls. Speculative pre-warm pushes the hot blob into
+    each fresh engine before sessions return, so the post-restart TTFT
+    of the hot prompt (admitted over fetched bytes) is compared against
+    the TTFT of a same-length NEVER-seen prompt on the very same
+    restarted replica — exactly what the restart would have cost every
+    prompt without the CDN. Both probes amortize their jit compiles via
+    untimed same-shape decoy sessions first (see bench_kvtier)."""
+    import random
+
+    from fei_tpu.agent.providers import JaxLocalProvider
+    from fei_tpu.ui.server import ServeAPI
+    from fei_tpu.utils.metrics import METRICS
+
+    os.environ.setdefault("FEI_TPU_KV_TIER", "ram")
+    sessions = max(8, int(os.environ.get("FEI_TPU_BENCH_SESSIONS", "28")))
+    repos = 6
+
+    def make_api(tag: str):
+        # pool wide enough that every repo context stays resident in the
+        # prefix cache — this suite measures dedup and fetch, not the
+        # eviction churn bench_kvtier owns
+        eng = _make_engine(
+            model, max_seq_len=512, paged=True, batch_size=2,
+            page_size=4, num_pages=512, prefix_cache=True,
+        )
+        return ServeAPI(JaxLocalProvider(engine=eng), model_name=tag)
+
+    def chat(api, body) -> dict:
+        status, payload = api.handle(
+            "POST", "/v1/chat/completions", dict(body), {})[:2]
+        if status != 200:
+            raise RuntimeError(f"kvcdn bench request failed: {payload}")
+        return payload
+
+    # -- phase 1: Zipfian repo mix on one engine ----------------------------
+    ctx = [
+        ("Repository %02d context: module layout, paging design, "
+         "scheduler admission flow, tier spill policy, router affinity. "
+         % r) * 2
+        for r in range(repos)
+    ]
+    rng = random.Random(0)
+    weights = [1.0 / (r + 1) for r in range(repos)]  # Zipf s=1
+    picks = rng.choices(range(repos), weights=weights, k=sessions)
+
+    api = make_api("kvcdn")
+    c0 = METRICS.snapshot()["counters"]
+    prompt_tokens = 0
+    t0 = time.perf_counter()
+    for i, r in enumerate(picks):
+        out = chat(api, {
+            "messages": [{"role": "user", "content": ctx[r]}],
+            "max_tokens": 4, "temperature": 0, "session": f"cdn-{i}",
+        })
+        prompt_tokens += int(out.get("usage", {}).get("prompt_tokens", 0))
+    dt = time.perf_counter() - t0
+    snap = METRICS.snapshot()
+    c1, gauges = snap["counters"], snap["gauges"]
+
+    def delta(name: str) -> float:
+        return float(c1.get(name, 0)) - float(c0.get(name, 0))
+
+    prefilled = delta("scheduler.prefill_tokens")
+    flops_saved = (
+        100.0 * (1.0 - prefilled / prompt_tokens) if prompt_tokens else 0.0
+    )
+    extra: dict = {
+        "sessions": sessions,
+        "repos": repos,
+        "prompt_tokens": int(prompt_tokens),
+        "prefill_tokens": int(prefilled),
+        "kv_cas_stores": delta("kv.cas_stores"),
+        "kv_cas_dedup_hits": delta("kv.cas_dedup_hits"),
+        "kv_dedup_ratio": round(float(gauges.get("kv.dedup_ratio", 0)), 3),
+        "kv_prefix_tokens_saved": delta("kv.prefix_tokens_saved"),
+    }
+    log(f"bench: kvcdn zipf mix done in {dt:.1f}s: "
+        f"{sessions} sessions / {repos} repos, "
+        f"prefilled {int(prefilled)}/{prompt_tokens} prompt tokens "
+        f"-> {flops_saved:.1f}% prefill flops saved, "
+        f"dedup_ratio={extra['kv_dedup_ratio']}")
+    api.provider.engine.close()
+
+    # -- phase 2: rolling restart, pre-warmed vs never-seen TTFT ------------
+    import tempfile
+
+    from fei_tpu.fleet import InProcessReplica, Router
+
+    # long probes: at tiny scale a short prompt's prefill is too cheap
+    # to see against the fetch+scatter cost the CDN pays instead
+    def _body(fill: str) -> dict:
+        return {
+            "messages": [{"role": "user", "content":
+                          fill * 400 + " :kvcdn restart probe"}],
+            "max_tokens": 1, "temperature": 0,
+        }
+
+    hot, decoy, decoy2, cold = (_body(f) for f in "xyzw")
+
+    replicas = [
+        InProcessReplica(
+            f"r{i}", factory=lambda: make_api("kvcdn-fleet"),
+            drain_dir=tempfile.mkdtemp(prefix=f"fei-bench-kvcdn-r{i}-"),
+        )
+        for i in range(2)
+    ]
+    router = Router(replicas, retries=2, backoff_s=0.02, health_ttl_s=0.1)
+
+    def ttft_ms(rep, req) -> float:
+        t0 = time.perf_counter()
+        status, payload, _ = rep.request(
+            "POST", "/v1/chat/completions", dict(req), {})
+        if status != 200:
+            raise RuntimeError(f"kvcdn restart probe failed: {payload}")
+        return (time.perf_counter() - t0) * 1000
+
+    # serve the hot prompt on both replicas (publishes its blob into both
+    # tiers) and compile the prefix-hit geometry the warm probe takes;
+    # decoy2 is served too so pre-warm carries ITS blob as well — the
+    # post-restart decoy2 session then runs the fetch-and-scatter path
+    # untimed, amortizing its one-time compile before the hot probe
+    for rep in replicas:
+        ttft_ms(rep, hot)
+        ttft_ms(rep, hot)
+        ttft_ms(rep, decoy2)
+    warm_ms = ttft_ms(replicas[1], hot)
+
+    c0 = METRICS.snapshot()["counters"]
+    report = router.rolling_restart(drain_deadline_s=60.0, wait_s=120.0)
+    if not all(v.get("healthy") for v in report.values()):
+        raise RuntimeError(f"kvcdn rolling restart failed: {report}")
+    c1 = METRICS.snapshot()["counters"]
+    prewarm_pushes = (c1.get("router.prewarm_pushes", 0)
+                      - c0.get("router.prewarm_pushes", 0))
+
+    # fresh engines: amortize compiles untimed — full prefill (decoy),
+    # then a pre-warmed CAS admission (decoy2: fetch, scatter, and the
+    # chunked prefix-hit geometry the hot probe will take)
+    probe_rep = replicas[1]
+    ttft_ms(probe_rep, decoy)
+    ttft_ms(probe_rep, decoy2)
+    c0 = METRICS.snapshot()["counters"]
+    prewarmed_ms = ttft_ms(probe_rep, hot)   # admits over pre-warmed bytes
+    c1 = METRICS.snapshot()["counters"]
+    cas_admitted = (c1.get("kv.prefix_hits_tier", 0)
+                    - c0.get("kv.prefix_hits_tier", 0)) >= 1
+    hot_local_ms = ttft_ms(probe_rep, hot)   # second hit: local prefix
+    cold_ms = ttft_ms(probe_rep, cold)       # never-seen: full prefill
+    for rep in replicas:
+        eng = rep.engine
+        if eng is not None:
+            eng.close()
+    extra.update({
+        "restart_prewarm_pushes": int(prewarm_pushes),
+        "restart_hot_cas_admitted": bool(cas_admitted),
+        "warm_ttft_ms": round(warm_ms, 1),
+        "restart_prewarmed_ttft_ms": round(prewarmed_ms, 1),
+        "restart_hot_local_ttft_ms": round(hot_local_ms, 1),
+        "restart_cold_ttft_ms": round(cold_ms, 1),
+        "restart_ttft_speedup": (
+            round(cold_ms / prewarmed_ms, 2) if prewarmed_ms > 0 else None
+        ),
+    })
+    log(f"bench: kvcdn restart ttft prewarmed={prewarmed_ms:.1f}ms "
+        f"cold={cold_ms:.1f}ms warm-baseline={warm_ms:.1f}ms "
+        f"(prewarm_pushes={int(prewarm_pushes)}, "
+        f"cas_admitted={cas_admitted})")
+    return _emit(f"{_tag(model)}_kvcdn_prefill_flops_saved_pct",
+                 flops_saved, unit="%", extra=extra)
+
+
 def bench_agent(model: str, n_tokens: int) -> int:
     """End-to-end `fei --message` shape (BASELINE config #3): chat template
     -> jax_local provider -> engine stream -> incremental detokenize ->
@@ -1494,6 +1678,10 @@ def main() -> int:
     elif suite == "kvtier":
         # park/resume churn is about pool pressure, not model weight
         default_model = "tiny"
+    elif suite == "kvcdn":
+        # content-addressed dedup/pre-warm is about prefix bytes moving,
+        # not model weight
+        default_model = "tiny"
     elif suite == "fleet":
         # two engines in one process: tiny keeps the burst about QoS
         # shape, not model weight; override with FEI_TPU_BENCH_MODEL
@@ -1547,6 +1735,8 @@ def main() -> int:
         return bench_fleet(model, n_tokens)
     if suite == "kvtier":
         return bench_kvtier(model, n_tokens)
+    if suite == "kvcdn":
+        return bench_kvcdn(model, n_tokens)
     if suite == "agent":
         return bench_agent(model, n_tokens)
     return bench_decode(model, n_tokens)

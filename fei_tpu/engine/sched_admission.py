@@ -35,6 +35,11 @@ from fei_tpu.utils.metrics import METRICS
 
 log = get_logger("scheduler")
 
+# pseudo seq-id for in-flight content-addressed imports: real slots are
+# 0..B-1, spill keys are request ids, migration imports use -7777
+# (kv/migrate.py) — this collides with none of them
+_CAS_ID = -7778
+
 
 class AdmissionMixin:
     """Request admission: queue -> slot -> prefilled pages -> first token."""
@@ -187,6 +192,18 @@ class AdmissionMixin:
                     seq, slot, prefix
                 ):
                     continue
+                # KV CDN (ISSUE 18): a fresh request the local prefix
+                # cache couldn't fully serve may still have its prefix
+                # BYTES in the tier under a content hash — published by
+                # another session here, or pushed by a peer replica.
+                # Fetching the missing tail beats re-prefilling it; a
+                # hit then takes the standard chunked prefix-hit route
+                # below, so downstream byte-identity is exactly the
+                # proven local-hit path.
+                if not seq.generated:
+                    cas = self._try_cas_admit(seq, slot, prefix)
+                    if cas:
+                        seq.prefix_match = prefix = cas
                 # long prompts on an sp mesh admit SEQUENCE-SHARDED in one
                 # dispatch (ring-attention full-model prefill via
                 # engine.prefill's routing) — n× fewer dispatches than
@@ -847,6 +864,13 @@ class AdmissionMixin:
         if resume:
             self._resume_delivered(seq, n, prefix_pages)
             return
+        # flops actually spent: prompt tokens minus the prefix pages that
+        # arrived via cache/tier hit (the bench's prefill-savings numerator)
+        METRICS.incr(
+            "scheduler.prefill_tokens",
+            max(0, n - prefix_pages * alloc.page_size),
+        )
+        self._cas_publish(seq, ids, pages)
         if seq.budget <= 0:
             self._finish(seq)
             return
@@ -977,6 +1001,149 @@ class AdmissionMixin:
         tier.drop(seq.rid)  # one-shot: a later preemption re-spills
         self._resume_delivered(seq, n, prefix_pages=m, recomputed=0)
         return True
+
+    def _try_cas_admit(self, seq: _Seq, slot: int,
+                       prefix: list[int]) -> list[int]:
+        """Local prefix shortfall → content-addressed tier fetch
+        (KV CDN). ``prefix`` is the local prefix-cache match already
+        shared into ``slot`` — usually just the chat-template pages
+        every prompt shares. Probes the prompt's page-boundary content
+        hashes longest-first for any boundary PAST the local match; on
+        a hit, allocates only the missing pages under a pseudo-id,
+        scatters the blob's tail arrays, registers the full prefix, and
+        shares the new pages into ``slot`` — exactly
+        ``kv/migrate.import_blob``'s dance, but keyed by content so ANY
+        session over the same tokens (or a blob a peer pushed over
+        ``POST /kv/prefix``) hits. Returns the full prefix page list
+        now shared into the slot ([] = nothing gained — the caller
+        keeps its local match, which is always correct). Never raises:
+        every tier-side failure rides the ``kv.fetch`` fault-point
+        contract and degrades to plain prefill."""
+        tier = self._kv_tier
+        if tier is None or not self._cas_enabled or self._prefix is None:
+            return []
+        from fei_tpu.kv.pagesio import pool_fingerprint, scatter_pages
+        from fei_tpu.obs.costmodel import account_kv_transfer
+
+        alloc = self.engine._allocator
+        ids = self._prefill_ids(seq)
+        ps = self.engine.page_size
+        have = len(prefix)
+        # strictly shorter than the prompt, like PrefixCache.match: at
+        # least one suffix token must remain to produce logits
+        max_m = (len(ids) - 1) // ps
+        if max_m <= have:
+            return []  # the local match already covers every boundary
+        try:
+            keys = self._cas_keys(ids, max_m)
+            for m in range(max_m, have, -1):
+                key = keys[m - 1]
+                if not tier.contains(key):
+                    continue
+                entry = tier.fetch(key)  # kv.fetch faults fire here
+                if entry is None:
+                    continue
+                if (
+                    entry.n_tokens != m * ps
+                    or entry.page_size != ps
+                    or entry.n_pages != m
+                    or entry.fingerprint != pool_fingerprint(self._pool)
+                ):
+                    # a stale or peer-pushed blob that doesn't match this
+                    # pool is useless now and forever — drop, try shorter
+                    tier.drop(key)
+                    continue
+                # the blob carries all m pages from position 0; the first
+                # ``have`` are already in the slot via the local match —
+                # allocate and scatter only the missing tail
+                grow = m - have
+                got = alloc.try_alloc(_CAS_ID, grow)
+                if got is None:
+                    self._prefix.evict_for(grow)
+                    got = alloc.try_alloc(_CAS_ID, grow)
+                if got is None:
+                    return []  # no room even after eviction: prefill
+                try:
+                    t0 = time.perf_counter()
+                    with METRICS.span("kv_fetch"):
+                        self._pool = scatter_pages(
+                            self._pool, got,
+                            {k: v[have:m] for k, v in entry.arrays.items()},
+                        )
+                    t1 = time.perf_counter()
+                    full = list(prefix) + list(got)
+                    self._prefix.register(ids[: m * ps], full)
+                    alloc.share(slot, got)
+                finally:
+                    # registry + slot refs keep the pages; the import's
+                    # own claim must die even if the scatter raised
+                    alloc.free(_CAS_ID)
+                METRICS.incr("kv.prefix_hits_tier")
+                METRICS.incr("kv.prefix_tokens_saved", grow * ps)
+                nbytes = sum(
+                    int(v[have:m].nbytes) for v in entry.arrays.values()
+                )
+                account_kv_transfer("fetched", nbytes, t1 - t0)
+                FLIGHT.dispatch(
+                    "dispatch.kv_cas_fetch", t0, t1, t1, rid=seq.rid,
+                    mesh=mesh_tag(self.engine.mesh), slot=slot, pages=grow,
+                    bytes=nbytes,
+                )
+                return full
+        except Exception as exc:  # noqa: BLE001 — corrupt entry, I/O
+            # error, injected hang: all mean "prefill instead"
+            METRICS.incr("kv.fetch_fallbacks")
+            log.warning(
+                "cas prefix fetch for %s failed (%r); prefilling",
+                seq.rid, exc,
+            )
+        return []
+
+    def _cas_publish(self, seq: _Seq, ids, pages) -> None:
+        """Make a freshly admitted prompt's full-page prefix available
+        under its content hash — to every other session through the
+        local tier, and to every other replica through
+        ``GET /kv/prefix/<hash>``. Dedup by construction:
+        ``put_if_absent`` stores at most one copy no matter how many
+        sessions admit the same prefix (the factory only gathers on
+        absence), and each live session pins the key so budget pressure
+        cannot evict bytes the fleet is actively sharing. Best-effort:
+        any failure only costs future fetch hits."""
+        tier = self._kv_tier
+        if tier is None or not self._cas_enabled:
+            return
+        ps = self.engine.page_size
+        # strictly-shorter boundary, NOT len//ps: an admission must keep
+        # at least one token to prefill for logits, so the probe side
+        # (_try_cas_admit / content_prefix_status) never looks past
+        # (n-1)//ps pages — publishing a page-aligned prompt at its full
+        # boundary would store a key no consumer can ever ask for
+        m = (len(ids) - 1) // ps
+        if m <= 0:
+            return
+        from fei_tpu.kv.pagesio import gather_pages, pool_fingerprint
+        from fei_tpu.kv.tier import PageEntry
+
+        try:
+            key = self._cas_keys(ids, m)[m - 1]
+            if seq.cas_key is None:
+                tier.pin(key)
+                seq.cas_key = key
+
+            def make_entry() -> PageEntry:
+                with METRICS.span("kv_spill"):
+                    arrays = gather_pages(self._pool, list(pages[:m]))
+                return PageEntry(
+                    key=key, n_tokens=m * ps, page_size=ps,
+                    fingerprint=pool_fingerprint(self._pool),
+                    arrays=arrays,
+                )
+
+            tier.put_if_absent(key, make_entry)
+        except Exception as exc:  # noqa: BLE001 — a failed publish only
+            # costs the fleet a future fetch hit; the admission stands
+            METRICS.incr("kv.spill_failures")
+            log.warning("cas publish for %s failed: %r", seq.rid, exc)
 
     def _gather_fn(self, gm: int, bucket: int):
         """Compiled prefix gather: ``gm`` (power-of-two padded) cached pages
@@ -1121,6 +1288,11 @@ class AdmissionMixin:
         if resume:
             self._resume_delivered(seq, n, prefix_pages)
             return
+        METRICS.incr(
+            "scheduler.prefill_tokens",
+            max(0, n - prefix_pages * alloc.page_size),
+        )
+        self._cas_publish(seq, ids, pages)
         if seq.budget <= 0:
             self._finish(seq)
             return

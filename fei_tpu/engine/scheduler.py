@@ -138,6 +138,10 @@ class _Seq:
     # preempt and shed first) and queue-full eviction
     tenant: str = "default"
     priority: int = 0
+    # content-addressed prefix key this sequence pinned in the KV tier
+    # (kv/content.py); unpinned at _finish/cancel so the refcount tracks
+    # exactly the live sessions sharing the entry
+    cas_key: str | None = None
 
 
 class PagedScheduler(AdmissionMixin, DecodeMixin, ConstraintMixin):
@@ -322,6 +326,15 @@ class PagedScheduler(AdmissionMixin, DecodeMixin, ConstraintMixin):
 
         _tier_cfg = TierConfig.from_env()
         self._kv_tier = KVTierStore(_tier_cfg) if _tier_cfg.enabled else None
+        # content-addressed prefix store (KV CDN, kv/content.py): with
+        # the tier on, finished admissions publish their full-page prefix
+        # under a content hash and a local prefix MISS tries a tier fetch
+        # before prefilling. FEI_TPU_KV_CDN=0 opts out (tier keeps the
+        # session-keyed spill/resume behavior only).
+        self._cas_enabled = self._kv_tier is not None and _os.environ.get(
+            "FEI_TPU_KV_CDN", "1"
+        ).strip().lower() not in ("0", "off", "false")
+        self._cas_salt: bytes | None = None  # lazy: needs the live pool
         # control-plane closures (KV export/import for migration) run on
         # the loop thread between dispatches — the donated pool is
         # single-owner state and must never race a dispatch
@@ -668,6 +681,9 @@ class PagedScheduler(AdmissionMixin, DecodeMixin, ConstraintMixin):
                 seq.finished = True
                 if self._kv_tier is not None:  # a preempted waiter's
                     self._kv_tier.drop(seq.rid)  # spilled pages die here
+                    if seq.cas_key is not None:
+                        self._kv_tier.unpin(seq.cas_key)
+                        seq.cas_key = None
                 self._trace_finish(seq, "cancelled")
                 return
             seq.cancelled = True
@@ -779,6 +795,41 @@ class PagedScheduler(AdmissionMixin, DecodeMixin, ConstraintMixin):
         from fei_tpu.kv.migrate import import_blob
 
         return self.run_ctl(lambda: import_blob(self, blob))
+
+    def content_prefix_status(self, prompt_ids, cap: int = 8) -> dict:
+        """Candidate content hashes for ``prompt_ids``' page boundaries
+        (longest first, capped at ``cap``) plus which of them this
+        replica's tier already holds — the router's fetch-on-miss and
+        pre-warm oracle (``POST /kv/prefix/probe``). Safe from any
+        thread (run_ctl; the salt needs the live pool's fingerprint)."""
+        ids = [int(t) for t in prompt_ids]
+
+        def work() -> dict:
+            if self._kv_tier is None or not self._cas_enabled:
+                return {"hashes": [], "have": []}
+            self._ensure_pool()
+            max_m = max(0, (len(ids) - 1) // self.engine.page_size)
+            keys = self._cas_keys(ids, max_m)
+            hashes = list(reversed(keys))[: max(1, int(cap))]
+            have = [k for k in hashes if self._kv_tier.contains(k)]
+            return {"hashes": hashes, "have": have}
+
+        return self.run_ctl(work)
+
+    def _cas_keys(self, ids, n_pages: int) -> list[str]:
+        """Content keys for the first 1..n_pages boundaries of ``ids``.
+        Loop thread only (reads the live pool's fingerprint once)."""
+        from fei_tpu.kv.content import content_keys, content_salt
+        from fei_tpu.kv.pagesio import pool_fingerprint
+
+        if self._cas_salt is None:
+            self._cas_salt = content_salt(
+                getattr(self.engine.cfg, "name", ""),
+                pool_fingerprint(self._pool),
+            )
+        return content_keys(
+            ids, n_pages, self.engine.page_size, self._cas_salt
+        )
 
     _IDLE_PARKS = 600  # ~60 s of nothing to do -> park the thread
 
@@ -965,6 +1016,9 @@ class PagedScheduler(AdmissionMixin, DecodeMixin, ConstraintMixin):
             seq.gaccepted = bool(seq.gfallback_state.get("accepted"))
         if self._kv_tier is not None:
             self._kv_tier.drop(seq.rid)
+            if seq.cas_key is not None:
+                self._kv_tier.unpin(seq.cas_key)
+                seq.cas_key = None
         slot = seq.slot
         if slot >= 0 and self._slots[slot] is seq:
             self._evict_slot(slot)

@@ -30,6 +30,17 @@ affinity there, so follow-up turns decode where decode is cheap. Both
 moves are strictly best-effort: any failure costs one re-prefill,
 exactly the pre-migration world.
 
+Content-addressed prefixes (KV CDN, kv/content.py) extend the same idea
+to sessions NO replica remembers: a cold forward probes its destination
+(``POST /kv/prefix/probe``) for the content hashes the prompt would
+admit through and pulls the blob from any peer advertising it
+(``GET /kv/prefix/<hash>`` → ``POST /kv/prefix``); ``prewarm()`` pushes
+the fleet's hottest prefixes into a replica before sessions land there,
+and ``rolling_restart`` calls it the moment a restarted replica probes
+back — hot-prefix TTFT survives the restart. Both are best-effort
+(``kv.prefix_hits_remote`` / ``router.prefix_fetch_failures`` /
+``router.prewarm_pushes`` / ``router.prewarm_failures``).
+
 Failure handling:
 
 - **Circuit breaker** per replica: ``FEI_TPU_FLEET_BREAKER_FAILS``
@@ -165,6 +176,17 @@ class Router:
         self.prefill_tokens = max(
             1, _env_int("FEI_TPU_ROUTER_PREFILL_TOKENS", 512)
         )
+        # KV CDN (content-addressed prefixes): resolve a COLD session's
+        # prefix from any peer advertising its content hash before the
+        # forward lands, and pre-warm a restarted replica with the
+        # fleet's hottest prefixes before sessions return to it
+        self.prefix_fetch = os.environ.get(
+            "FEI_TPU_FLEET_PREFIX_FETCH", "1"
+        ).strip().lower() not in ("0", "off", "false")
+        self.prewarm_enabled = os.environ.get(
+            "FEI_TPU_FLEET_PREWARM", "1"
+        ).strip().lower() not in ("0", "off", "false")
+        self.prewarm_k = max(1, _env_int("FEI_TPU_FLEET_PREWARM_K", 8))
         self._affinity: OrderedDict[str, str] = OrderedDict()
         self._lock = threading.Lock()
 
@@ -381,6 +403,144 @@ class Router:
             return
         self._migrate(prev, rid, body)
 
+    # -- content-addressed prefixes (KV CDN) --------------------------------
+
+    def _push_prefix(self, src: str, dst: str, h: str) -> bool:
+        """GET one content-addressed blob off ``src`` and push it into
+        ``dst``'s tier. True only when ``dst`` answered 200 (a dedup
+        ``stored: false`` still counts — the bytes are there). Never
+        raises."""
+        try:
+            status, payload, _ = self.replicas[src].request(
+                "GET", f"/kv/prefix/{h}"
+            )
+            blob = payload.get("blob") if isinstance(payload, dict) else None
+            if status != 200 or not blob:
+                return False
+            status, _out, _ = self.replicas[dst].request(
+                "POST", "/kv/prefix", {"hash": h, "blob": blob}
+            )
+            return status == 200
+        except Exception as exc:  # noqa: BLE001 — a prefix push must
+            # never take down the forward or sweep it rides along with
+            log.debug("prefix push %s %s->%s failed: %r", h, src, dst, exc)
+            return False
+
+    def _peer_prefix_sets(self, exclude=()) -> dict[str, set]:
+        """Content hashes each reachable replica advertises. Draining
+        replicas stay included on purpose — /kv routes outlive the
+        rotation exactly so their warm bytes can leave the ship."""
+        out: dict[str, set] = {}
+        for r in self._order:
+            if r in exclude:
+                continue
+            try:
+                status, payload, _ = self.replicas[r].request(
+                    "GET", "/kv/prefix"
+                )
+            except Exception:  # noqa: BLE001 — unreachable peer: skip
+                continue
+            if status == 200 and isinstance(payload, dict):
+                hs = payload.get("hashes") or []
+                if hs:
+                    out[r] = set(hs)
+        return out
+
+    def _maybe_prefix_fetch(self, key: str | None, rid: str,
+                            body: dict) -> None:
+        """Cold-session repair — the content-addressed complement of
+        ``_maybe_migrate``: no replica remembers this session, but a
+        peer may already hold the prompt's prefix bytes under their
+        content hash. Probe the destination for the hashes it would
+        admit through, find a peer advertising one, and push the blob
+        ahead of the forward. Strictly best-effort and never raises;
+        every failure costs exactly the re-prefill that would have
+        happened anyway."""
+        if not self.prefix_fetch or key is None or len(self.replicas) < 2:
+            return
+        with self._lock:
+            prev = self._affinity.get(key)
+        if prev is not None:
+            return  # warm session: _maybe_migrate owns this case
+        if not isinstance(body.get("messages"), list):
+            return
+        try:
+            status, payload, _ = self.replicas[rid].request(
+                "POST", "/kv/prefix/probe",
+                {"messages": body.get("messages"),
+                 "tools": body.get("tools")},
+            )
+            if status != 200 or not isinstance(payload, dict):
+                return
+            have = set(payload.get("have") or [])
+            want = [h for h in payload.get("hashes") or [] if h not in have]
+            if not want:
+                return
+            peers = self._peer_prefix_sets(exclude=(rid,))
+            for h in want:  # longest prefix first (probe order)
+                srcs = [r for r, s in peers.items() if h in s]
+                for src in srcs:
+                    if self._push_prefix(src, rid, h):
+                        METRICS.incr("kv.prefix_hits_remote")
+                        FLIGHT.event("router_prefix_fetch", src=src,
+                                     dst=rid, hash=h)
+                        return  # one prefix is all an admission can use
+                if srcs:
+                    METRICS.incr("router.prefix_fetch_failures")
+        except Exception as exc:  # noqa: BLE001
+            METRICS.incr("router.prefix_fetch_failures")
+            log.debug("prefix fetch ahead of %s failed: %r", rid, exc)
+
+    def prewarm(self, rid: str) -> int:
+        """Speculative pre-warm: push the fleet's hottest
+        content-addressed prefixes (each peer's advertised list is MRU-
+        ordered) into ``rid``'s tier BEFORE sessions land there —
+        ``rolling_restart`` calls this the moment a restarted replica
+        probes back healthy, so the first wave of returning sessions
+        admits over fetched bytes instead of cold prefill. At most
+        ``FEI_TPU_FLEET_PREWARM_K`` pushes; returns how many landed."""
+        if not self.prewarm_enabled:
+            return 0
+        pushed = 0
+        try:
+            status, payload, _ = self.replicas[rid].request(
+                "GET", "/kv/prefix"
+            )
+            have = set(
+                (payload.get("hashes") or [])
+                if status == 200 and isinstance(payload, dict) else []
+            )
+            for src in [r for r in self._order if r != rid]:
+                if pushed >= self.prewarm_k:
+                    break
+                try:
+                    status, payload, _ = self.replicas[src].request(
+                        "GET", "/kv/prefix"
+                    )
+                except Exception:  # noqa: BLE001
+                    continue
+                if status != 200 or not isinstance(payload, dict):
+                    continue
+                for h in payload.get("hashes") or []:
+                    if pushed >= self.prewarm_k:
+                        break
+                    if h in have:
+                        continue
+                    if self._push_prefix(src, rid, h):
+                        pushed += 1
+                        have.add(h)
+                        METRICS.incr("router.prewarm_pushes")
+                    else:
+                        METRICS.incr("router.prewarm_failures")
+        except Exception as exc:  # noqa: BLE001 — pre-warm is a bonus,
+            # never a blocker: the replica serves cold without it
+            METRICS.incr("router.prewarm_failures")
+            log.debug("prewarm of %s failed: %r", rid, exc)
+        if pushed:
+            log.info("prewarmed %s with %d prefix blobs", rid, pushed)
+            FLIGHT.event("router_prewarm", replica=rid, pushed=pushed)
+        return pushed
+
     def _handoff(self, key: str | None, rid: str, body: dict) -> None:
         """Prefill→decode handoff (role split): after a prefill-heavy
         replica served a request, push the prompt's KV to the
@@ -515,8 +675,11 @@ class Router:
                 break
             if attempt == 0:
                 # the session's home replica fell out of rotation: bring
-                # its warm KV to wherever this request is about to land
+                # its warm KV to wherever this request is about to land;
+                # a session NO replica remembers may still find its
+                # prefix bytes on a peer by content hash (KV CDN)
                 self._maybe_migrate(key, rid, body)
+                self._maybe_prefix_fetch(key, rid, body)
             fwd = dict(headers or {})
             if remaining is not None:
                 fwd["X-FEI-Deadline-S"] = f"{remaining:.3f}"
@@ -599,6 +762,7 @@ class Router:
                 break
             if attempt == 0:
                 self._maybe_migrate(key, rid, body)
+                self._maybe_prefix_fetch(key, rid, body)
             fwd = dict(headers)
             if remaining is not None:
                 fwd["X-FEI-Deadline-S"] = f"{remaining:.3f}"
@@ -750,6 +914,12 @@ class Router:
                     back = True
                     break
                 time.sleep(0.05)
+            if back:
+                # speculative pre-warm BEFORE sessions return: the fresh
+                # engine's tier gets the fleet's hottest prefixes now,
+                # so returning traffic admits over fetched bytes and the
+                # restart stays TTFT-neutral for hot prefixes
+                self.prewarm(rid)
             FLIGHT.event("router_restart_done", replica=rid,
                          restored=restored)
             report[rid] = {"drained": bool(drained),

@@ -22,6 +22,12 @@ Fault points ``kv.spill`` / ``kv.fetch`` (engine/faults.py) fire inside
 ``put``/``fetch`` so the chaos stages can prove the fallback story:
 an I/O error, corrupt checksum, or slow-fetch hang surfaces as an
 exception the scheduler converts into plain replay — never a wedge.
+
+The content-addressed (CDN) layer rides the same store: ``cas:*`` keys
+(kv/content.py) land via ``put_if_absent`` — N sessions over one prompt
+prefix share exactly one copy — and live sessions ``pin`` the entry so
+budget pressure cannot evict bytes the fleet is actively rendezvousing
+on (an explicit ``drop`` still wins; pins guard pressure, not intent).
 """
 
 from __future__ import annotations
@@ -30,6 +36,7 @@ import hashlib
 import json
 import os
 import queue
+import re
 import struct
 import tempfile
 import threading
@@ -57,6 +64,31 @@ def _env_int(name: str, default: int) -> int:
         return default
 
 
+_SIZE_RE = re.compile(r"^([0-9]*\.?[0-9]+)\s*([kmgt]?)i?b?$", re.IGNORECASE)
+
+
+def parse_size(text, default: int) -> int:
+    """Forgiving human-readable byte sizes for the FEI_TPU_KV_*_BYTES
+    knobs: ``268435456``, ``256MiB``, ``4g``, ``1.5 G``, ``512kb``.
+    Binary multipliers throughout — a fleet config that says ``4g``
+    means 4 GiB of budget, not a 7% haircut — and unparseable input
+    falls back to ``default`` with a warning rather than refusing to
+    boot (a typo'd budget must not take a replica out of rotation)."""
+    if text is None:
+        return default
+    s = str(text).strip()
+    if not s:
+        return default
+    m = _SIZE_RE.match(s)
+    if not m:
+        log.warning("unparseable byte size %r; using default %d",
+                    text, default)
+        return default
+    mult = {"": 1, "k": 1 << 10, "m": 1 << 20,
+            "g": 1 << 30, "t": 1 << 40}[m.group(2).lower()]
+    return int(float(m.group(1)) * mult)
+
+
 @dataclass(frozen=True)
 class TierConfig:
     """Parsed ``FEI_TPU_KV_*`` knobs. ``mode``: ``off`` (no tier — replay
@@ -76,8 +108,12 @@ class TierConfig:
             mode = "off"
         return TierConfig(
             mode=mode,
-            ram_bytes=_env_int("FEI_TPU_KV_RAM_BYTES", 256 * 1024 * 1024),
-            disk_bytes=_env_int("FEI_TPU_KV_DISK_BYTES", 1024 * 1024 * 1024),
+            ram_bytes=parse_size(
+                os.environ.get("FEI_TPU_KV_RAM_BYTES"), 256 * 1024 * 1024
+            ),
+            disk_bytes=parse_size(
+                os.environ.get("FEI_TPU_KV_DISK_BYTES"), 1024 * 1024 * 1024
+            ),
             disk_dir=os.environ.get("FEI_TPU_KV_DISK_DIR", "")
             or os.path.join(tempfile.gettempdir(), "fei_kv_tier"),
         )
@@ -211,6 +247,13 @@ class KVTierStore:
         self._disk_bytes = 0
         self._q: queue.Queue = queue.Queue()
         self._writer: threading.Thread | None = None
+        # content-addressed (CDN) state: pin refcounts — one per live
+        # session sharing the entry — guard budget eviction (an explicit
+        # drop() still wins: pins protect against *pressure*, not intent);
+        # the hit/store tallies drive the kv.dedup_ratio gauge
+        self._pins: dict[str, int] = {}
+        self._cas_hits = 0
+        self._cas_stores = 0
 
     # -- paths / gauges ---------------------------------------------------
 
@@ -234,6 +277,9 @@ class KVTierStore:
                 "pending": len(self._pending),
                 "disk_entries": len(self._disk),
                 "disk_bytes": self._disk_bytes,
+                "pinned_keys": len(self._pins),
+                "cas_dedup_hits": self._cas_hits,
+                "cas_stores": self._cas_stores,
             }
 
     # -- writer thread ----------------------------------------------------
@@ -298,7 +344,16 @@ class KVTierStore:
             METRICS.incr("kv.demotions")
             evict = []
             while self._disk_bytes > self.cfg.disk_bytes and len(self._disk) > 1:
-                k, nb = self._disk.popitem(last=False)
+                # coldest UNPINNED file goes first; when only pinned
+                # entries remain the rung runs over budget rather than
+                # deleting bytes live sessions still rendezvous on
+                k = next(
+                    (c for c in self._disk if self._pins.get(c, 0) <= 0),
+                    None,
+                )
+                if k is None:
+                    break
+                nb = self._disk.pop(k)
                 self._disk_bytes -= nb
                 evict.append(k)
                 METRICS.incr("kv.evictions")
@@ -326,7 +381,23 @@ class KVTierStore:
             demote: list[str] = []
             drop: list[str] = []
             while self._ram_bytes > self.cfg.ram_bytes and len(self._ram) > 1:
-                k, e = self._ram.popitem(last=False)
+                # coldest entry first, but a pinned entry only moves to a
+                # rung it stays fetchable from: with disk on it demotes
+                # like anything else; RAM-only mode would LOSE it, so the
+                # scan skips pinned keys (and the rung runs over budget
+                # when nothing unpinned remains)
+                k = next(
+                    (
+                        c for c in self._ram
+                        if c != key
+                        and (self.cfg.disk_enabled
+                             or self._pins.get(c, 0) <= 0)
+                    ),
+                    None,
+                )
+                if k is None:
+                    break
+                e = self._ram.pop(k)
                 self._ram_bytes -= e.nbytes
                 if self.cfg.disk_enabled:
                     self._pending[k] = e
@@ -403,7 +474,9 @@ class KVTierStore:
 
     def drop(self, key: str) -> None:
         """Forget ``key`` at every rung (sequence finished or its entry
-        went stale)."""
+        went stale). Deliberately ignores pins: they guard against
+        budget pressure, not against a caller that KNOWS the entry is
+        stale/poisoned."""
         with self._lock:
             e = self._ram.pop(key, None)
             if e is not None:
@@ -411,12 +484,94 @@ class KVTierStore:
             self._drop_cold_locked(key)
             self._gauges_locked()
 
+    # -- content-addressed (CDN) API ---------------------------------------
+
+    def pin(self, key: str) -> None:
+        """Take one eviction-protection reference on ``key`` (a live
+        session shares its bytes). Pinning an absent key is legal — the
+        pin guards whatever lands under the key later."""
+        with self._lock:
+            self._pins[key] = self._pins.get(key, 0) + 1
+
+    def unpin(self, key: str) -> None:
+        with self._lock:
+            n = self._pins.get(key, 0) - 1
+            if n <= 0:
+                self._pins.pop(key, None)
+            else:
+                self._pins[key] = n
+
+    def pin_count(self, key: str) -> int:
+        with self._lock:
+            return self._pins.get(key, 0)
+
+    def contains(self, key: str) -> bool:
+        """Presence probe across every rung; no LRU touch, no I/O."""
+        with self._lock:
+            return (
+                key in self._ram
+                or key in self._pending
+                or key in self._disk
+            )
+
+    def put_if_absent(self, key: str, make_entry) -> bool:
+        """The dedup rendezvous: store ``make_entry()`` under ``key``
+        unless any rung already holds it — N publishers of the same
+        content, exactly one copy. ``make_entry`` may be a ``PageEntry``
+        or a zero-arg factory; the factory only runs on absence, so a
+        duplicate publish never pays the device→host gather. True when
+        this call stored."""
+        with self._lock:
+            if (
+                key in self._ram
+                or key in self._pending
+                or key in self._disk
+            ):
+                if key in self._ram:
+                    self._ram.move_to_end(key)
+                self._cas_hits += 1
+                METRICS.incr("kv.cas_dedup_hits")
+                self._dedup_gauge_locked()
+                return False
+        entry = make_entry() if callable(make_entry) else make_entry
+        self.put(key, entry)
+        with self._lock:
+            self._cas_stores += 1
+            METRICS.incr("kv.cas_stores")
+            self._dedup_gauge_locked()
+        return True
+
+    def _dedup_gauge_locked(self) -> None:
+        total = self._cas_hits + self._cas_stores
+        if total:
+            METRICS.gauge("kv.dedup_ratio", self._cas_hits / total)
+
+    def advertised(self, limit: int = 64) -> list[str]:
+        """Content-addressed keys this store can serve, hottest first
+        (RAM in MRU order, then in-flight demotions, then disk MRU) —
+        the ``GET /kv/prefix`` payload peers and the pre-warm pass read."""
+        from fei_tpu.kv.content import is_cas_key
+
+        out: list[str] = []
+        seen: set[str] = set()
+        with self._lock:
+            for rung in (
+                reversed(self._ram), iter(self._pending),
+                reversed(self._disk),
+            ):
+                for k in rung:
+                    if is_cas_key(k) and k not in seen:
+                        seen.add(k)
+                        out.append(k)
+        return out[: max(0, int(limit))]
+
     def clear(self) -> None:
         with self._lock:
             keys = list(self._disk)
             self._ram.clear()
             self._pending.clear()
             self._disk.clear()
+            self._pins.clear()
             self._ram_bytes = self._disk_bytes = 0
             self._gauges_locked()
         for k in keys:

@@ -50,6 +50,11 @@ METRIC_REGISTRY: dict[str, tuple[str, str]] = {
     "scheduler.resume_replayed_tokens": (
         "counter", "Generated-suffix tokens replayed through the decode-"
                    "shaped forward at resume (bitwise KV rebuild)."),
+    "scheduler.prefill_tokens": (
+        "counter", "Prompt tokens actually prefilled at admission "
+                   "(prefix-cache and content-addressed tier hits "
+                   "excluded); divide by total prompt tokens for the "
+                   "flops-saved ratio."),
     "scheduler.lazy_grown_pages": (
         "counter", "KV pages allocated mid-decode for lazily-reserved "
                    "sequences."),
@@ -233,6 +238,38 @@ METRIC_REGISTRY: dict[str, tuple[str, str]] = {
                           "KV pages landed by migration imports."),
     "kv.bytes_migrated": ("counter",
                           "Bytes serialized into migration blobs."),
+    "kv.cas_stores": ("counter",
+                      "Content-addressed prefix blobs stored in the tier "
+                      "(first copy of that content)."),
+    "kv.cas_dedup_hits": ("counter",
+                          "Content-addressed publishes deduplicated "
+                          "against an existing tier copy (N sessions, "
+                          "one copy)."),
+    "kv.prefix_hits_tier": ("counter",
+                            "Admissions whose prefix pages were fetched "
+                            "from the local tier by content hash instead "
+                            "of re-prefilled."),
+    "kv.prefix_tokens_saved": ("counter",
+                               "Prompt tokens NOT re-prefilled thanks to "
+                               "content-addressed tier hits."),
+    "kv.prefix_hits_remote": ("counter",
+                              "Prefix blobs the router fetched from a "
+                              "peer replica and placed ahead of a cold "
+                              "forward."),
+    "router.prefix_fetch_failures": ("counter",
+                                     "Best-effort peer prefix fetches "
+                                     "that failed (probe error, no "
+                                     "source served the blob, push "
+                                     "refused); the session simply "
+                                     "prefills."),
+    "router.prewarm_pushes": ("counter",
+                              "Hot prefix blobs pushed into a replica by "
+                              "speculative pre-warm (rolling restart / "
+                              "scale-up)."),
+    "router.prewarm_failures": ("counter",
+                                "Pre-warm pushes that failed (replica "
+                                "unreachable, refused, or corrupt blob); "
+                                "the replica serves cold instead."),
     "engine.compiles": ("counter",
                         "Jit program compilations observed (first build "
                         "per program signature — warmup cost)."),
@@ -299,6 +336,10 @@ METRIC_REGISTRY: dict[str, tuple[str, str]] = {
                            "Bytes resident in the on-disk KV tier."),
     "kv.tier_entries": ("gauge",
                         "Entries resident across both KV tiers."),
+    "kv.dedup_ratio": ("gauge",
+                       "Fraction of content-addressed publishes that "
+                       "deduplicated against an existing copy "
+                       "(hits / (hits + stores))."),
     "kv.spilled_gbps": ("gauge",
                         "Achieved HBM -> host throughput of the most "
                         "recent spill (GB/s)."),

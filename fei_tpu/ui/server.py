@@ -310,6 +310,18 @@ class ServeAPI:
             return self._kv_export(body)
         if route == "/kv/import" and method == "POST":
             return self._kv_import(body)
+        # content-addressed prefix control plane (KV CDN): list what this
+        # replica can serve, probe what a prompt would admit through,
+        # fetch one blob by hash, push one into the tier. All routable
+        # while draining for the same reason as export/import.
+        if route == "/kv/prefix" and method == "GET":
+            return self._kv_prefix_list()
+        if route == "/kv/prefix" and method == "POST":
+            return self._kv_prefix_push(body)
+        if route == "/kv/prefix/probe" and method == "POST":
+            return self._kv_prefix_probe(body)
+        if route.startswith("/kv/prefix/") and method == "GET":
+            return self._kv_prefix_get(route.rsplit("/", 1)[1])
         if route == "/debug/profile" and method == "POST":
             return self._profile(body)
         return 404, {"error": {"message": f"no route {method} {route}",
@@ -518,6 +530,123 @@ class ServeAPI:
             return 500, {"error": {"message": f"{type(exc).__name__}: {exc}",
                                    "type": "server_error"}}
         return 200, {"object": "kv.import", "pages": int(pages)}
+
+    # -- content-addressed prefixes (KV CDN control plane) -------------------
+
+    def _kv_tier_store(self):
+        sched = self._kv_scheduler()
+        return getattr(sched, "_kv_tier", None)
+
+    def _kv_prefix_list(self) -> tuple:
+        """Content hashes this replica's tier can serve, hottest first —
+        what peers and the router's pre-warm pass read. An empty list is
+        a healthy answer (tier off, or simply nothing published yet)."""
+        tier = self._kv_tier_store()
+        hashes = [] if tier is None else tier.advertised()
+        return 200, {"object": "kv.prefix.list", "hashes": hashes}
+
+    def _kv_prefix_get(self, key: str) -> tuple:
+        """One content-addressed prefix blob by hash. 404 = not here (the
+        caller tries the next peer); tier-side faults (the ``kv.fetch``
+        point fires on this path too) answer 500 JSON, never a socket
+        drop — the peer-fetch caller treats any non-200 as a miss."""
+        tier = self._kv_tier_store()
+        if tier is None:
+            return 404, {"error": {
+                "message": "this replica runs without a KV tier",
+                "type": "invalid_request_error"}}
+        from fei_tpu.kv.tier import pack_entry
+
+        try:
+            entry = tier.fetch(key)
+        except Exception as exc:  # noqa: BLE001
+            log.warning("kv prefix fetch %s failed: %r", key, exc)
+            return 500, {"error": {"message": f"{type(exc).__name__}: {exc}",
+                                   "type": "server_error"}}
+        if entry is None:
+            return 404, {"error": {
+                "message": f"no prefix {key!r} in the tier",
+                "type": "invalid_request_error"}}
+        blob = pack_entry(entry)
+        return 200, {"object": "kv.blob", "hash": key, "bytes": len(blob),
+                     "blob": base64.b64encode(blob).decode("ascii")}
+
+    def _kv_prefix_push(self, body: dict) -> tuple:
+        """Peer push: land a content-addressed blob in this replica's
+        tier WITHOUT touching the pool — thread-safe, no loop-thread
+        hop, no pages consumed; the next admission over matching tokens
+        fetches the pages in through ``_try_cas_admit``. 422 for a
+        corrupt blob or a non-content-addressed key; ``stored: false``
+        means the tier already held it (dedup), which is success."""
+        from fei_tpu.kv.content import is_cas_key
+        from fei_tpu.kv.tier import unpack_entry
+        from fei_tpu.utils.errors import KVTierError
+
+        tier = self._kv_tier_store()
+        if tier is None:
+            return 501, {"error": {
+                "message": "kv prefix push needs a KV tier "
+                           "(FEI_TPU_KV_TIER)",
+                "type": "invalid_request_error"}}
+        raw = body.get("blob")
+        if not isinstance(raw, str) or not raw:
+            return 400, {"error": {"message": "blob must be a base64 string",
+                                   "type": "invalid_request_error"}}
+        try:
+            blob = base64.b64decode(raw, validate=True)
+        except (binascii.Error, ValueError):
+            return 400, {"error": {"message": "blob is not valid base64",
+                                   "type": "invalid_request_error"}}
+        try:
+            entry, _ = unpack_entry(blob)
+        except KVTierError as exc:
+            return 422, {"error": {"message": str(exc),
+                                   "type": "invalid_request_error"}}
+        key = body.get("hash") or entry.key
+        if not is_cas_key(key) or key != entry.key:
+            return 422, {"error": {
+                "message": "hash does not name a content-addressed "
+                           "prefix blob",
+                "type": "invalid_request_error"}}
+        try:
+            stored = tier.put_if_absent(key, entry)
+        except Exception as exc:  # noqa: BLE001 — injected spill faults
+            # and disk errors answer JSON; the pusher counts and moves on
+            log.warning("kv prefix push %s failed: %r", key, exc)
+            return 500, {"error": {"message": f"{type(exc).__name__}: {exc}",
+                                   "type": "server_error"}}
+        return 200, {"object": "kv.prefix.push", "hash": key,
+                     "stored": bool(stored), "bytes": entry.nbytes}
+
+    def _kv_prefix_probe(self, body: dict) -> tuple:
+        """Which content hashes would this prompt admit through (longest
+        first), and which are already local — the router's fetch-on-miss
+        oracle. Renders the prompt exactly like a completion would, so
+        the hashes name the prefix a later ``/v1/chat/completions`` on
+        this body actually hits."""
+        sched = self._kv_scheduler()
+        if sched is None or not hasattr(self.provider,
+                                        "_messages_with_system"):
+            return 501, {"error": {
+                "message": "kv prefix probe needs an engine-backed "
+                           "provider",
+                "type": "invalid_request_error"}}
+        if (getattr(sched, "_kv_tier", None) is None
+                or not getattr(sched, "_cas_enabled", False)):
+            return 200, {"object": "kv.prefix.probe",
+                         "hashes": [], "have": []}
+        try:
+            ids = self._prompt_ids(body)
+        except (ValueError, KeyError, TypeError) as exc:
+            return 400, {"error": {"message": str(exc),
+                                   "type": "invalid_request_error"}}
+        try:
+            st = sched.content_prefix_status(ids)
+        except Exception as exc:  # noqa: BLE001
+            log.warning("kv prefix probe failed: %r", exc)
+            return 500, {"error": {"message": f"{type(exc).__name__}: {exc}",
+                                   "type": "server_error"}}
+        return 200, {"object": "kv.prefix.probe", **st}
 
     @staticmethod
     def _retry_after(exc) -> dict:

@@ -151,13 +151,168 @@ def kv_main() -> int:
     return 0
 
 
+def kvcdn_main() -> int:
+    """KV CDN smoke (``FEI_TPU_FLEET_SMOKE_MODE=kvcdn``).
+
+    Two tiny replicas with the host KV tier on and content-addressed
+    prefixes enabled. Phase 1 lands several sessions sharing ONE prompt
+    on r0 — the tier must hold exactly one content-addressed copy
+    (``kv.cas_stores`` moves once, ``kv.cas_dedup_hits`` absorbs the
+    rest). Phase 2 drains r0 and sends COLD sessions with the same
+    prompt through the router: they land on r1, the router pulls the
+    prefix blob off draining r0 by content hash
+    (``kv.prefix_hits_remote``), and r1 admits over fetched bytes
+    (``kv.prefix_hits_tier``) instead of re-prefilling. Phase 3 rolls
+    the fleet and asserts speculative pre-warm pushed hot prefixes into
+    the restarted replicas (``router.prewarm_pushes``). The pipelines
+    re-run this mode with FEI_TPU_FAULT sweeping ``kv.fetch`` — under
+    chaos every CDN rung is ALLOWED to fall back to plain prefill, but
+    every request must still reach 200 (degrade, never wedge)."""
+    import os
+    import tempfile
+
+    os.environ.setdefault("FEI_TPU_KV_TIER", "ram")
+    os.environ.setdefault("FEI_TPU_MAX_QUEUE", "32")
+
+    from fei_tpu.agent.providers import JaxLocalProvider
+    from fei_tpu.engine.engine import InferenceEngine
+    from fei_tpu.fleet import InProcessReplica, Router
+    from fei_tpu.ui.server import ServeAPI
+    from fei_tpu.utils.metrics import METRICS
+
+    def factory():
+        # roomy pool: this smoke is about prefix bytes moving, not
+        # preemption churn (kv_main owns that)
+        engine = InferenceEngine.from_config(
+            "tiny", paged=True, batch_size=2, page_size=4, num_pages=64,
+            max_seq_len=256, prefix_cache=True,
+        )
+        return ServeAPI(JaxLocalProvider(engine=engine), model_name="fleet")
+
+    replicas = [
+        InProcessReplica(
+            f"r{i}", factory=factory,
+            drain_dir=tempfile.mkdtemp(prefix=f"fei-kvcdn-smoke-r{i}-"),
+        )
+        for i in range(2)
+    ]
+    router = Router(replicas, retries=2, backoff_s=0.02, health_ttl_s=0.1)
+    chaos = "kv." in os.environ.get("FEI_TPU_FAULT", "")
+    c0 = METRICS.snapshot()["counters"]
+
+    def delta(k: str) -> float:
+        return METRICS.snapshot()["counters"].get(k, 0) - c0.get(k, 0)
+
+    # every session shares this prompt: the content hash is the same
+    # fleet-wide, which is the entire point of the CDN
+    shared = ("Summarize the shared repository context: module layout, "
+              "paging design, and the scheduler admission flow.")
+
+    def body(i: int) -> dict:
+        return {
+            "messages": [{"role": "user", "content": shared}],
+            "max_tokens": 8, "temperature": 0, "session": f"cdn-{i}",
+        }
+
+    def send(via, i: int) -> tuple[bool, str]:
+        last = "no attempt"
+        for _ in range(80):
+            if via is router:
+                res = router.handle("POST", "/v1/chat/completions",
+                                    body(i), {})
+            else:
+                res = via.request("POST", "/v1/chat/completions",
+                                  body(i), {})
+            if res[0] == 200:
+                return True, "ok"
+            last = f"{res[0]}: {res[1]}"
+            time.sleep(0.05)
+        return False, last
+
+    # --- 1. one prompt, many sessions, ONE tier copy on r0 -----------------
+    n_warm = 6
+    for i in range(n_warm):
+        ok, why = send(replicas[0], i)
+        if not ok:
+            return fail(f"warm session {i} never landed on r0: {why}")
+    if not chaos:
+        if delta("kv.cas_stores") < 1:
+            return fail("no content-addressed blob was ever published "
+                        f"(cas_stores={delta('kv.cas_stores'):.0f})")
+        if delta("kv.cas_dedup_hits") < 1:
+            return fail(
+                f"{n_warm} identical sessions produced no dedup hit "
+                f"(dedup_hits={delta('kv.cas_dedup_hits'):.0f})"
+            )
+    print(f"fleet smoke(kvcdn): warm ok — {n_warm} sessions, "
+          f"cas_stores={delta('kv.cas_stores'):.0f} "
+          f"dedup_hits={delta('kv.cas_dedup_hits'):.0f}")
+
+    # --- 2. drain r0; cold sessions on r1 fetch the prefix by hash ---------
+    try:
+        replicas[0].request("POST", "/drain", {})
+    except Exception as exc:  # noqa: BLE001
+        return fail(f"drain of r0 failed: {exc!r}")
+    for i in range(n_warm, n_warm + 3):
+        ok, why = send(router, i)
+        if not ok:
+            return fail(f"cold session {i} lost during r0 drain: {why}")
+    if not chaos:
+        if delta("kv.prefix_hits_remote") < 1:
+            return fail(
+                "router never fetched the prefix off draining r0 "
+                f"(remote_hits={delta('kv.prefix_hits_remote'):.0f} "
+                f"fetch_failures={delta('router.prefix_fetch_failures'):.0f})"
+            )
+        if delta("kv.prefix_hits_tier") < 1:
+            return fail(
+                "r1 never admitted over fetched bytes "
+                f"(tier_hits={delta('kv.prefix_hits_tier'):.0f})"
+            )
+    print(f"fleet smoke(kvcdn): fetch ok — "
+          f"remote_hits={delta('kv.prefix_hits_remote'):.0f} "
+          f"tier_hits={delta('kv.prefix_hits_tier'):.0f} "
+          f"tokens_saved={delta('kv.prefix_tokens_saved'):.0f}")
+
+    # --- 3. rolling restart pre-warms the fresh replicas -------------------
+    report = router.rolling_restart(drain_deadline_s=60.0, wait_s=120.0)
+    if not all(v.get("healthy") for v in report.values()):
+        return fail(f"a replica did not come back healthy: {report}")
+    if not chaos and delta("router.prewarm_pushes") < 1:
+        return fail(
+            "rolling restart never pre-warmed a fresh replica "
+            f"(pushes={delta('router.prewarm_pushes'):.0f} "
+            f"failures={delta('router.prewarm_failures'):.0f})"
+        )
+    ok, why = send(router, n_warm + 3)
+    if not ok:
+        return fail(f"post-restart session lost: {why}")
+    print(
+        "fleet smoke(kvcdn): OK — "
+        f"cas_stores={delta('kv.cas_stores'):.0f} "
+        f"dedup_hits={delta('kv.cas_dedup_hits'):.0f} "
+        f"remote_hits={delta('kv.prefix_hits_remote'):.0f} "
+        f"tier_hits={delta('kv.prefix_hits_tier'):.0f} "
+        f"prewarm_pushes={delta('router.prewarm_pushes'):.0f} "
+        f"fetch_fallbacks={delta('kv.fetch_fallbacks'):.0f}"
+        + (" [chaos]" if chaos else "")
+    )
+    for r in replicas:
+        eng = r.engine
+        if eng is not None:
+            eng.close()
+    return 0
+
+
 def main() -> int:
     import os
     import tempfile
 
-    if os.environ.get("FEI_TPU_FLEET_SMOKE_MODE", "").lower() in (
-            "kv", "kvtier"):
+    mode = os.environ.get("FEI_TPU_FLEET_SMOKE_MODE", "").lower()
+    if mode in ("kv", "kvtier"):
         return kv_main()
+    if mode == "kvcdn":
+        return kvcdn_main()
 
     # QoS env must land before any engine builds its TenantBook
     os.environ.setdefault("FEI_TPU_TENANT_BUDGETS",

@@ -203,6 +203,23 @@ stage chaos_kv_fetch_hang env FEI_TPU_FLEET_SMOKE_MODE=kv \
 stage bench_kvtier run_bench env FEI_TPU_BENCH_SUITE=kvtier \
   FEI_TPU_BENCH_MAX_WAIT_S=300 python -u bench.py
 
+# 0d1d. KV CDN ON-CHIP (docs/KV.md "Content-addressed prefixes"): the
+# cdn suite against real device dispatches (content keys, dedup/pin,
+# byte-identical cross-engine admit over fetched bytes), then the
+# dedup + fetch-on-miss + pre-warm smoke through the router, then the
+# kv.fetch chaos sweep on the SAME smoke — injected peer-fetch
+# failures must degrade to plain prefill, never wedge or lose a
+# request
+stage kvcdn env FEI_TPU_TEST_PLATFORM=tpu python -m pytest \
+  tests/test_kv_cdn.py -q --timeout 900
+stage kvcdn_smoke env FEI_TPU_FLEET_SMOKE_MODE=kvcdn \
+  python -u scripts/fleet_smoke.py
+stage chaos_kvcdn_fetch env FEI_TPU_FLEET_SMOKE_MODE=kvcdn \
+  FEI_TPU_FAULT="kv.fetch:io:2,kv.fetch:corrupt:2,kv.fetch:hang:1" \
+  python -u scripts/fleet_smoke.py
+stage bench_kvcdn run_bench env FEI_TPU_BENCH_SUITE=kvcdn \
+  FEI_TPU_BENCH_MAX_WAIT_S=300 python -u bench.py
+
 # 0d2. flight-recorder timeline smoke ON-CHIP: mixed workload (concurrent
 # admissions, turbo decode, organic preemption) against real device
 # dispatches, then /debug/timeline must return valid Chrome-trace JSON

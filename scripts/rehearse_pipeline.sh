@@ -229,6 +229,21 @@ stage chaos_kv_fetch_hang -- env FEI_TPU_FLEET_SMOKE_MODE=kv \
 stage bench_kvtier --json -- env FEI_TPU_BENCH_SUITE=kvtier \
   python -u bench.py
 
+# --- KV CDN (content-addressed prefixes, docs/KV.md): the cdn suite
+# runs FOR REAL (content keys, dedup/pin, byte-identical cross-engine
+# admit, endpoint round-trip), then the dedup + fetch-on-miss +
+# pre-warm smoke through the router, then the kv.fetch chaos sweep on
+# the SAME smoke — an injected peer-fetch failure must degrade to
+# plain prefill, never wedge or lose a request ----
+stage kvcdn -- python -m pytest tests/test_kv_cdn.py -q --timeout 900
+stage kvcdn_smoke -- env FEI_TPU_FLEET_SMOKE_MODE=kvcdn \
+  python -u scripts/fleet_smoke.py
+stage chaos_kvcdn_fetch -- env FEI_TPU_FLEET_SMOKE_MODE=kvcdn \
+  FEI_TPU_FAULT="kv.fetch:io:2,kv.fetch:corrupt:2,kv.fetch:hang:1" \
+  python -u scripts/fleet_smoke.py
+stage bench_kvcdn --json -- env FEI_TPU_BENCH_SUITE=kvcdn \
+  FEI_TPU_BENCH_SESSIONS=12 python -u bench.py
+
 echo
 echo "=== rehearsal results ==="
 for r in "${RESULTS[@]}"; do echo "$r"; done

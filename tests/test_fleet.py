@@ -50,6 +50,11 @@ class FakeReplica:
         if path == "/drain":
             self.health["status"] = "draining"
             return 202, {"status": "draining"}, {}
+        if path.startswith("/kv/"):
+            # kv control-plane probes (migration, CDN prefix fetch) answer
+            # structurally, like a replica without the routes: scripted
+            # .answers belong to the chat forwards under test
+            return 404, {"error": {"message": "no kv routes here"}}, {}
         if self.fail_with is not None:
             raise self.fail_with
         if self.answers:
