@@ -51,6 +51,14 @@ Suites (FEI_TPU_BENCH_SUITE):
                      extras: gold (priority 2) p99 vs its unloaded
                      baseline, and the share of sheds absorbed by bronze
                      (priority 0)
+  crash            — mid-burst replica death at ~2x overload: a replica
+                     is severed while streams are in flight and the
+                     router resurrects every affected session on the
+                     survivor. Headline is resurrection MTTR (client-
+                     visible stream gap); extras carry tokens replayed,
+                     dropped accepted streams (the zero-loss claim wants
+                     0) and the journal-sync decode A/B
+                     (disabled/batch/always tok/s)
 
 Knobs:
   FEI_TPU_BENCH_MODEL    (decode default llama3-8b — the BASELINE config #2
@@ -1184,6 +1192,237 @@ def bench_fleet(model: str, n_tokens: int) -> int:
                  unit="tok/s", extra=extra)
 
 
+def bench_crash(model: str, n_tokens: int) -> int:
+    """Mid-burst replica death: resurrection MTTR + the journal tax.
+
+    Phase 1 — ~2x-overload burst of streams through the router over two
+    in-process replicas; once every stream has tokens flowing, replica
+    r0 is severed (health and every live stream raise, exactly what a
+    SIGKILL looks like from the router's side). The router must
+    resurrect every affected stream on r1 with the delivered suffix
+    teacher-forced. Headline: MTTR — the client-visible inter-frame gap
+    the failover cost, taken as the top-R max gaps after the kill (R =
+    resurrections; unaffected streams keep their normal decode
+    cadence). Extras: tokens replayed, dropped accepted streams (the
+    zero-loss claim wants 0).
+
+    Phase 2 — journal sync A/B: single-stream decode tok/s with the
+    session journal disabled, FEI_TPU_JOURNAL_SYNC=batch, and =always
+    (the fsync-per-record fleet mode), so the durability tax is a
+    recorded number, not folklore."""
+    import tempfile
+    import threading
+
+    from fei_tpu.agent.providers import JaxLocalProvider
+    from fei_tpu.fleet import InProcessReplica, Router
+    from fei_tpu.fleet.router import _parse_sse
+    from fei_tpu.ui.server import ServeAPI
+    from fei_tpu.utils.metrics import METRICS
+
+    os.environ.setdefault("FEI_TPU_MAX_QUEUE", "32")
+    sessions = int(os.environ.get("FEI_TPU_BENCH_SESSIONS", "8"))
+    # streams must outlive the kill by a wide margin or the burst
+    # degenerates into pre-commit retries (nothing to resurrect), so the
+    # crash suite enforces a floor on the per-stream budget
+    budget = min(max(n_tokens, 16), 32)
+
+    class _Mortal:
+        """Delegating wrapper that can drop dead mid-stream."""
+
+        def __init__(self, inner):
+            self._inner = inner
+            self.dead = False
+
+        def __getattr__(self, name):
+            return getattr(self._inner, name)
+
+        def health(self):
+            if self.dead:
+                raise ConnectionError(f"{self._inner.rid} is dead")
+            return self._inner.health()
+
+        def request(self, *a, **k):
+            if self.dead:
+                raise ConnectionError(f"{self._inner.rid} is dead")
+            return self._inner.request(*a, **k)
+
+        def stream(self, body, headers=None):
+            inner = self._inner.stream(body, headers)
+
+            def frames():
+                for f in inner:
+                    if self.dead:
+                        raise ConnectionError(
+                            f"{self._inner.rid} died mid-stream"
+                        )
+                    yield f
+
+            return frames()
+
+    def make_api():
+        engine = _make_engine(
+            model, max_seq_len=512, paged=True, batch_size=2, page_size=16,
+        )
+        return ServeAPI(JaxLocalProvider(engine=engine), model_name="crash")
+
+    replicas = [_Mortal(InProcessReplica(f"r{i}", api=make_api()))
+                for i in range(2)]
+    router = Router(replicas, retries=2, backoff_s=0.05, health_ttl_s=0.2)
+    c0 = METRICS.snapshot()["counters"]
+
+    delivered = [0]  # content frames across all streams (kill trigger)
+    dl_lock = threading.Lock()
+    results: list[dict] = []
+    res_lock = threading.Lock()
+    t_kill = [None]
+
+    def one_stream(idx: int):
+        body = {
+            "messages": [{"role": "user", "content": f"crash bench {idx}"}],
+            "max_tokens": budget, "temperature": 0, "ignore_eos": True,
+            "session": f"crash-{idx}",
+        }
+        frame_times, tokens, err = [], 0, None
+        for chunk in router.stream_chat(body, {}):
+            info = _parse_sse(chunk)
+            if info is None:
+                continue
+            if info.get("error"):
+                err = dict(info["error"])
+                break
+            delta = (info.get("choices") or [{}])[0].get("delta") or {}
+            if delta.get("content"):
+                tokens += 1
+                frame_times.append(time.perf_counter())
+                with dl_lock:
+                    delivered[0] += 1
+        with res_lock:
+            results.append(
+                {"tokens": tokens, "err": err, "times": frame_times}
+            )
+
+    def killer():
+        # sever as soon as streams have genuinely committed tokens —
+        # waiting longer lets short streams finish and turns the kill
+        # into a boring pre-commit retry
+        deadline = time.time() + 120.0
+        while time.time() < deadline:
+            with dl_lock:
+                if delivered[0] >= 2:
+                    break
+            time.sleep(0.005)
+        t_kill[0] = time.perf_counter()
+        replicas[0].dead = True
+        log("bench: crash: severed r0 mid-burst")
+
+    log(f"bench: crash burst: {sessions} streams x {budget} tokens, "
+        "killing r0 mid-flight...")
+    t0 = time.time()
+    workers = [threading.Thread(target=one_stream, args=(i,))
+               for i in range(sessions)]
+    kth = threading.Thread(target=killer)
+    [w.start() for w in workers]
+    kth.start()
+    [w.join() for w in workers]
+    kth.join()
+    dt = time.time() - t0
+
+    c1 = METRICS.snapshot()["counters"]
+
+    def delta(k: str) -> float:
+        return c1.get(k, 0) - c0.get(k, 0)
+
+    resurrections = int(delta("router.resurrections"))
+    replayed = int(delta("router.resurrection_replayed_tokens"))
+    dropped = sum(1 for r in results if r["err"] is not None
+                  and r["tokens"] > 0)
+    sheds = sum(1 for r in results if r["err"] is not None
+                and r["tokens"] == 0)
+    total_tokens = sum(r["tokens"] for r in results)
+
+    # per-stream worst inter-frame gap after the kill; the top-R are the
+    # resurrected streams' failover stalls
+    gaps = []
+    tk = t_kill[0]
+    for r in results:
+        ts = [t for t in r["times"] if tk is None or t >= tk]
+        prev = tk
+        worst = 0.0
+        for t in ts:
+            if prev is not None:
+                worst = max(worst, t - prev)
+            prev = t
+        if worst > 0:
+            gaps.append(worst)
+    gaps.sort(reverse=True)
+    mttr = sorted(gaps[:resurrections]) if resurrections else []
+    mttr_p50 = mttr[len(mttr) // 2] if mttr else 0.0
+    mttr_max = mttr[-1] if mttr else 0.0
+
+    extra = {
+        "sessions": sessions,
+        "resurrections": resurrections,
+        "replayed_tokens": replayed,
+        "dropped_accepted": dropped,
+        "sheds": sheds,
+        "burst_agg_tok_s": round(total_tokens / dt, 2),
+        "mttr_max_ms": round(mttr_max * 1000, 1),
+    }
+    log(f"bench: crash burst done in {dt:.1f}s: "
+        f"resurrections={resurrections} replayed={replayed} "
+        f"dropped_accepted={dropped} mttr_p50={mttr_p50*1000:.1f}ms "
+        f"max={mttr_max*1000:.1f}ms")
+    for r in replicas:
+        eng = r._inner.engine
+        if eng is not None:
+            eng.close()
+
+    # -- phase 2: the journal durability tax --------------------------------
+    sync_ab: dict[str, float] = {}
+    saved = {k: os.environ.get(k)
+             for k in ("FEI_TPU_JOURNAL_DIR", "FEI_TPU_JOURNAL_SYNC")}
+    try:
+        for mode in ("disabled", "batch", "always"):
+            if mode == "disabled":
+                os.environ.pop("FEI_TPU_JOURNAL_DIR", None)
+                os.environ.pop("FEI_TPU_JOURNAL_SYNC", None)
+            else:
+                os.environ["FEI_TPU_JOURNAL_DIR"] = tempfile.mkdtemp(
+                    prefix=f"fei-bench-journal-{mode}-"
+                )
+                os.environ["FEI_TPU_JOURNAL_SYNC"] = mode
+            engine = _make_engine(
+                model, max_seq_len=512, paged=True, batch_size=1,
+                page_size=16,
+            )
+            provider = JaxLocalProvider(engine=engine)
+            msgs = [{"role": "user", "content": "journal tax probe"}]
+
+            def run(tokens: int) -> float:
+                t0 = time.perf_counter()
+                n = sum(1 for _ in provider.stream(
+                    msgs, max_tokens=tokens,
+                    gen_overrides={"temperature": 0.0, "ignore_eos": True},
+                ))
+                dt = time.perf_counter() - t0
+                return max(n, 1) / dt
+
+            run(4)  # compile warm-up
+            sync_ab[mode] = round(run(budget), 2)
+            log(f"bench: crash journal A/B {mode}: {sync_ab[mode]} tok/s")
+            engine.close()
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    extra["journal_sync_tok_s"] = sync_ab
+
+    return _emit("crash_resurrection_mttr_p50_ms", mttr_p50 * 1000,
+                 unit="ms", extra=extra)
+
+
 def bench_kvtier(model: str, n_tokens: int) -> int:
     """Tiered KV store under heavy slot oversubscription + migration.
 
@@ -1733,6 +1972,8 @@ def main() -> int:
         return bench_moe(model, n_tokens)
     if suite == "fleet":
         return bench_fleet(model, n_tokens)
+    if suite == "crash":
+        return bench_crash(model, n_tokens)
     if suite == "kvtier":
         return bench_kvtier(model, n_tokens)
     if suite == "kvcdn":

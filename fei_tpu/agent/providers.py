@@ -182,6 +182,9 @@ class JaxLocalProvider(Provider):
     name = "jax_local"
     # the serving endpoint may pass per-request sampling knobs
     supports_gen_overrides = True
+    # the serving endpoint may attach the failover side-channel
+    # (delivered-token export + teacher-forced resume)
+    supports_resume = True
 
     def __init__(
         self,
@@ -313,10 +316,11 @@ class JaxLocalProvider(Provider):
         return out
 
     def complete(self, messages, system=None, tools=None, max_tokens=4000,
-                 gen_overrides=None):
+                 gen_overrides=None, export=None, resume=None):
         chunks = []
         gen = self.stream(messages, system, tools, max_tokens,
-                          gen_overrides=gen_overrides)
+                          gen_overrides=gen_overrides, export=export,
+                          resume=resume)
         while True:
             try:
                 chunks.append(next(gen))
@@ -325,9 +329,16 @@ class JaxLocalProvider(Provider):
                 return resp
 
     def stream(self, messages, system=None, tools=None, max_tokens=4000,
-               gen_overrides=None):
+               gen_overrides=None, export=None, resume=None):
         """``gen_overrides`` (e.g. per-request temperature/top_p from the
-        serving endpoint) layer over the provider-level defaults."""
+        serving endpoint) layer over the provider-level defaults.
+
+        ``export``/``resume`` are the mid-stream failover side-channel
+        (plain generation only — tool-grammar and speculative routes
+        neither journal nor resurrect): ``export`` is filled in place
+        with the delivered token ids and per-token PRNG resume keys, and
+        ``resume`` teacher-forces a dead replica's delivered suffix so
+        the replayed stream is byte-identical."""
         full = self._messages_with_system(messages, system, tools)
         ids = self.engine.tokenizer.apply_chat_template(full, add_generation_prompt=True)
         gen = self._GenerationConfig(
@@ -370,6 +381,13 @@ class JaxLocalProvider(Provider):
             and grammar is None
             and os.environ.get("FEI_TPU_SPECULATE", "0") == "1"
         )
+        if resume is not None and grammar is not None:
+            # constrained requests are never journaled, so there is no
+            # legitimate resume payload for them; restarting the grammar
+            # walk from token 0 would duplicate the user-visible stream
+            raise ProviderError(
+                "mid-stream resume is not supported for tool-grammar turns"
+            )
         if grammar is not None:
             import functools
 
@@ -377,10 +395,14 @@ class JaxLocalProvider(Provider):
                 self.engine.generate_stream_toolcalls,
                 grammar=grammar, trigger=self.tool_trigger,
             )
-        elif speculate:
+        elif speculate and resume is None:
             stream_fn = self.engine.generate_stream_lookahead
         else:
-            stream_fn = self.engine.generate_stream
+            import functools
+
+            stream_fn = functools.partial(
+                self.engine.generate_stream, export=export, resume=resume,
+            )
         t_start = time.perf_counter()
         with METRICS.span("provider.jax_local"):
             for tok in stream_fn(ids, gen):

@@ -28,6 +28,35 @@ from fei_tpu.utils.logging import get_logger
 log = get_logger("engine.checkpoint")
 
 
+def fsync_file(path: str) -> None:
+    """fsync an already-written file by path. tmp-write + ``os.replace``
+    alone survives a process crash but NOT a host power cut: the rename
+    can hit the disk before the data blocks do, leaving a torn or empty
+    file behind a durable name. Shared by the drain snapshots and the
+    session journal's segment rotation."""
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def fsync_dir(directory: str) -> None:
+    """fsync a directory so a rename/create/unlink inside it is durable
+    (the directory entry itself lives in the parent's data blocks).
+    Best-effort on platforms whose directories reject O_RDONLY fsync."""
+    try:
+        fd = os.open(directory, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
 def _manager(directory: str, max_to_keep: int | None = 3):
     import orbax.checkpoint as ocp
 
@@ -158,7 +187,12 @@ def save_request_snapshots(
     try:
         with open(tmp, "w", encoding="utf-8") as f:
             json.dump(payload, f)
+        # durability, not just atomicity: fsync the data before the
+        # rename publishes it, and the directory after — a host power
+        # cut mid-drain must not tear or lose the snapshot file
+        fsync_file(tmp)
         os.replace(tmp, path)
+        fsync_dir(directory)
     except OSError as exc:
         raise CheckpointError(
             f"could not persist request snapshots to {path}: {exc}",

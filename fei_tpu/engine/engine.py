@@ -587,29 +587,103 @@ class InferenceEngine:
             return True
         return self._scheduler.wait_drained(timeout)
 
-    def warm_restart(self, snapshot_dir: str) -> list:
-        """Re-admit the request snapshots a previous process persisted at
-        drain. Each resumes byte-identically (re-prefill + saved PRNG key)
-        and replays its already-delivered tokens to the fresh consumer.
-        The snapshot file clears BEFORE re-admission (at-most-once: a
-        crash mid-replay must not double-serve on the next boot). Returns
-        the resubmitted sequence handles (stream each via
-        ``scheduler.drain(seq)``)."""
+    def warm_restart(self, snapshot_dir: str | None = None) -> list:
+        """Re-admit the in-flight work a previous process left behind.
+
+        Two sources, covering the two ways a process dies:
+
+        - **Drain snapshots** (``snapshot_dir``): the cooperative path —
+          a graceful drain persisted its still-queued set. The snapshot
+          file clears BEFORE re-admission (at-most-once: a crash
+          mid-replay must not double-serve on the next boot).
+        - **Session journal** (FEI_TPU_JOURNAL_DIR): the hard-crash path
+          — the WAL's admitted-but-unterminated sessions re-admit through
+          the same byte-identical resume machinery, teacher-forcing their
+          delivered tokens and re-installing the recorded PRNG state.
+          Recovered segments delete before re-admission (at-most-once;
+          the re-admissions re-journal into the new live segment).
+
+        Each resumed request replays its already-delivered tokens to the
+        fresh consumer, so the stream is byte-identical to the
+        uninterrupted run. Returns the resubmitted sequence handles
+        (stream each via ``scheduler.drain(seq)``)."""
         from fei_tpu.engine.checkpoint import (
             clear_request_snapshots,
             load_request_snapshots,
         )
         from fei_tpu.parallel.mesh import mesh_geometry
 
-        # refuses (CheckpointError) when the snapshots were drained on a
-        # different mesh geometry than this engine serves
-        snaps = load_request_snapshots(
-            snapshot_dir, expect_mesh=mesh_geometry(self.mesh)
+        seqs: list = []
+        snaps: list[dict] = []
+        if snapshot_dir:
+            # refuses (CheckpointError) when the snapshots were drained on
+            # a different mesh geometry than this engine serves
+            snaps = load_request_snapshots(
+                snapshot_dir, expect_mesh=mesh_geometry(self.mesh)
+            )
+            if snaps:
+                clear_request_snapshots(snapshot_dir)
+                seqs.extend(self.scheduler.restore_snapshots(snaps))
+        sched = self._scheduler
+        journal = None if sched is None else sched._journal
+        if journal is None:
+            return seqs
+        from fei_tpu.engine.journal import deadline_remaining
+
+        sessions, torn = journal.recover_and_clear()
+        if not sessions and not torn:
+            return seqs
+        snap_rids = {s.get("rid") for s in snaps}
+        mesh_now = mesh_geometry(self.mesh)
+        recovered = 0
+        for sess in sessions:
+            rid = sess.get("rid")
+            if rid in snap_rids:
+                # the drain snapshot owns this session (belt and braces:
+                # _finalize_drain also journals a "snapshotted" terminal)
+                continue
+            saved = sess.get("mesh") or {}
+            if {k: int(v) for k, v in saved.items()} != mesh_now:
+                # byte-identical resume replays KV through the same
+                # collective layout it was produced on — skip, don't guess
+                log.warning(
+                    "journal session %s was served on mesh %s, not this "
+                    "engine's %s; dropping it (resubmit required)",
+                    rid, saved, mesh_now,
+                )
+                continue
+            rem = None
+            if sess.get("deadline_epoch") is not None:
+                rem = deadline_remaining(sess["deadline_epoch"])
+                if rem <= 0:
+                    log.info(
+                        "journal session %s expired its deadline during "
+                        "the outage; dropping it", rid,
+                    )
+                    continue
+            gen_d = dict(sess.get("gen") or {})
+            gen_d["stop_token_ids"] = tuple(
+                gen_d.get("stop_token_ids") or ()
+            )
+            restore = {
+                "generated": sess.get("generated") or [],
+                "resume_key": sess.get("resume_key"),
+            }
+            if rem is not None:
+                restore["deadline_remaining_s"] = rem
+            seqs.append(self.scheduler.submit(
+                sess["prompt_ids"], GenerationConfig(**gen_d),
+                _restore=restore,
+            ))
+            recovered += 1
+        if recovered:
+            METRICS.incr("journal.recovered_sessions", recovered)
+            METRICS.incr("engine.crash_recoveries")
+        log.info(
+            "journal: recovered %d session(s) (%d torn record(s) "
+            "discarded)", recovered, torn,
         )
-        if not snaps:
-            return []
-        clear_request_snapshots(snapshot_dir)
-        return self.scheduler.restore_snapshots(snaps)
+        return seqs
 
     @property
     def scheduler(self):
@@ -782,12 +856,20 @@ class InferenceEngine:
         prompt_ids: Sequence[int],
         gen: GenerationConfig | None = None,
         logit_mask_fn: Callable[[list[int]], jnp.ndarray | None] | None = None,
+        export: dict | None = None,
+        resume: dict | None = None,
     ) -> Iterator[int]:
         """Stream sampled token ids for a single prompt (batch=1).
 
         ``logit_mask_fn`` (for grammar-constrained decoding) maps the tokens
         generated so far to a bool [V] mask of allowed next tokens, or None
         for unconstrained steps.
+
+        ``export`` / ``resume`` are the crash-consistency side channels
+        (scheduler.stream): ``export`` receives live per-token resume
+        state; ``resume`` teacher-forces an already-delivered suffix so a
+        surviving replica continues a dead peer's stream byte-identically.
+        Paged engines only — the dense path has no session journal.
 
         Unmasked dense decoding is FUSED-CHUNKED: one device dispatch per
         ``gen.chunk`` tokens (default ``FEI_TPU_DECODE_CHUNK``=16) with
@@ -800,8 +882,16 @@ class InferenceEngine:
         if self.paged:
             # continuous batching: the scheduler admits this request into a
             # batch slot; any number of concurrent streams share the pool
-            yield from self.scheduler.stream(prompt_ids, gen, logit_mask_fn)
+            yield from self.scheduler.stream(
+                prompt_ids, gen, logit_mask_fn,
+                export=export, resume=resume,
+            )
             return
+        if resume is not None:
+            raise EngineError(
+                "mid-stream resume requires a paged engine (the dense "
+                "path has no byte-identical replay machinery)"
+            )
         if logit_mask_fn is None and resolve_chunk(gen.chunk) > 1:
             yield from self._stream_chunked(
                 prompt_ids, gen, resolve_chunk(gen.chunk)

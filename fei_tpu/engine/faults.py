@@ -28,6 +28,10 @@ Points (the lint-style registry below is the source of truth):
 - ``replica.health``     — fleet router, before a replica health probe
 - ``kv.spill``           — tiered KV store, before a page spill lands
 - ``kv.fetch``           — tiered KV store, before a page fetch returns
+- ``journal.append``     — session journal, before a record append
+- ``journal.fsync``      — session journal, before an fsync
+- ``replica.crash``      — serving frame loop, per delivered frame (the
+  hard-kill seam the chaos_crash stage arms)
 
 Kinds map to exception types: ``request`` → RequestError, ``device`` →
 DeviceError, ``conn`` → urllib URLError, ``http429``/``http503`` →
@@ -43,6 +47,14 @@ injector's count expiring models the clearing). The kv points add
 ``io`` → OSError (a tier file that cannot be read/written) and
 ``corrupt`` → KVTierError (a checksum/version mismatch the unpack path
 would raise itself).
+
+``crash`` is the one kind that does not raise: it hard-kills the whole
+process with SIGKILL — no handlers, no drain, no atexit — modelling the
+kill -9 / OOM-killer death the session journal exists to survive. Its
+``count`` is a delay fuse rather than a fire budget: the fault fires on
+the count-th matching check (``replica.crash:crash:8`` kills on the 8th
+delivered frame), because "die mid-stream after N tokens" is the only
+useful arming and a kill can only ever fire once.
 """
 
 from __future__ import annotations
@@ -73,16 +85,32 @@ POINTS = (
     "replica.health",    # fleet router: before a replica health probe
     "kv.spill",          # tiered KV store: before a page spill lands
     "kv.fetch",          # tiered KV store: before a page fetch returns
+    "journal.append",    # session journal: before a record append
+    "journal.fsync",     # session journal: before an fsync
+    "replica.crash",     # serving frame loop: hard-kill seam (SIGKILL)
 )
 
 KINDS = (
     "request", "device", "conn", "http429", "http503",
-    "exhausted", "transient", "hang", "io", "corrupt",
+    "exhausted", "transient", "hang", "io", "corrupt", "crash",
 )
+
+
+def _hard_kill(point: str) -> None:
+    """SIGKILL this process — the real thing, not an exception. Module-
+    level so crash-path tests can monkeypatch it without arming an
+    actual suicide."""
+    import signal
+
+    os.kill(os.getpid(), signal.SIGKILL)
 
 
 def _make_exc(kind: str, point: str) -> BaseException:
     msg = f"injected {kind} fault at {point}"
+    if kind == "crash":
+        # never raised — check() routes crash to _hard_kill(); this arm
+        # exists only so eager kind validation accepts it
+        return SystemExit(msg)
     if kind == "request":
         return RequestError(msg)
     if kind == "device":
@@ -186,12 +214,17 @@ class FaultInjector:
             if fault.match is not None and not fault.match(ctx):
                 return
             fault.count -= 1
+            if fault.kind == "crash" and fault.count > 0:
+                return  # the count is a delay fuse: fire on the Nth check
             if fault.count <= 0:
                 self._armed.pop(point, None)
             self._fired[point] = self._fired.get(point, 0) + 1
             kind = fault.kind
         log.warning("firing injected %s fault at %s", kind, point)
         FLIGHT.event("fault", point=point, kind=kind, rid=ctx.get("rid"))
+        if kind == "crash":
+            _hard_kill(point)
+            return  # only reached when tests monkeypatch _hard_kill
         raise _make_exc(kind, point)
 
     def fired(self, point: str) -> int:

@@ -326,6 +326,7 @@ class AdmissionMixin:
             self._waiting.remove(s)
             s.finished = True
             self._trace_finish(s, "deadline_exceeded")
+            self._journal_end(s, "deadline_exceeded")
             METRICS.incr("scheduler.requests_shed")
             s.out.put(DeadlineExceededError(
                 f"request {s.rid} spent its whole "
@@ -345,6 +346,7 @@ class AdmissionMixin:
         self._slots[slot] = None
         seq.finished = True
         self._trace_finish(seq, "failed")
+        self._journal_end(seq, "failed")
         METRICS.incr("scheduler.requests_failed_isolated")
         seq.out.put(exc)
 
@@ -874,7 +876,12 @@ class AdmissionMixin:
         if seq.budget <= 0:
             self._finish(seq)
             return
-        self._deliver(seq, tok0)
+        first_key = None
+        if seq.journaled or seq.export is not None:
+            # the first token's resume state is the key installed above —
+            # PRNGKey(seed) after its prefill split, same as the chain
+            first_key = np.asarray(rng)
+        self._deliver(seq, tok0, key=first_key)
 
 
     def _resume_delivered(self, seq: _Seq, n: int, prefix_pages: int,
@@ -1296,7 +1303,12 @@ class AdmissionMixin:
         if seq.budget <= 0:
             self._finish(seq)
             return
-        self._deliver(seq, tok0)
+        first_key = None
+        if seq.journaled or seq.export is not None:
+            # the first token's resume state is the key installed above —
+            # PRNGKey(seed) after its prefill split, same as the chain
+            first_key = np.asarray(rng)
+        self._deliver(seq, tok0, key=first_key)
 
 
     def _admit_fn(self, bucket: int, n_pages: int):

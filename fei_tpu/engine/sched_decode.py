@@ -159,8 +159,14 @@ class DecodeMixin:
         METRICS.incr("scheduler.spec_steps")
         METRICS.incr("scheduler.spec_accepted", accept)
         delivered = 0
+        spec_key = None
+        if s.journaled or s.export is not None:
+            # the spec path is greedy-only and never advances the PRNG
+            # chain, so every token in the verified block shares the
+            # slot's current key state as its resume point
+            spec_key = np.asarray(self._keys[b])
         for t in [int(g) for g in greedy[: accept + 1]]:
-            self._deliver(s, t)
+            self._deliver(s, t, key=spec_key)
             if s.finished:
                 break
             delivered += 1
@@ -253,12 +259,20 @@ class DecodeMixin:
             # the device-native grammar path is measured against
             METRICS.incr("scheduler.host_mask_uploads", len(masks))
         toks = self._dispatch_steps(active, 1, mask=mask)
+        # per-token PRNG resume states (journal/export consumers only):
+        # _step_keys already synced with the dispatch, this is one D2H copy
+        keys_h = (
+            np.asarray(self._step_keys) if self._want_token_keys() else None
+        )
         for b, s in active:
             # defensive symmetry with the multi-step loop; with n=1 nothing
             # can replace a slot between assembly and delivery
             if self._slots[b] is not s:
                 continue
-            self._deliver(s, int(toks[b, 0]))
+            self._deliver(
+                s, int(toks[b, 0]),
+                key=None if keys_h is None else keys_h[0, b],
+            )
 
 
     def _try_multi_step(self) -> bool:
@@ -333,13 +347,22 @@ class DecodeMixin:
         METRICS.incr("scheduler.multi_tokens", n)
         if under_admission:
             METRICS.incr("scheduler.turbo_under_admission")
+        # stacked per-step PRNG states ([n, B, 2]): step_keys[i] is the
+        # chain after i+1 splits — exactly the per-token reference state
+        # after delivering i+1 tokens, which is what the journal records
+        keys_h = (
+            np.asarray(self._step_keys) if self._want_token_keys() else None
+        )
         rollback: dict[int, int] = {}
         for b, s in active:
             for i in range(n):
                 if self._slots[b] is not s:  # finished at an earlier step
                     break
                 was_free = s.grammar is not None and s.gstate < 0
-                self._deliver(s, int(toks[b, i]))
+                self._deliver(
+                    s, int(toks[b, i]),
+                    key=None if keys_h is None else keys_h[i, b],
+                )
                 if (
                     was_free
                     and i < n - 1

@@ -142,6 +142,16 @@ class _Seq:
     # (kv/content.py); unpinned at _finish/cancel so the refcount tracks
     # exactly the live sessions sharing the entry
     cas_key: str | None = None
+    # crash-consistency state. ``journaled`` marks a request whose
+    # admission landed in the session journal (engine/journal.py) — every
+    # delivered token and the terminal event follow it there. ``export``
+    # is a caller-owned dict the delivery path feeds live resume state
+    # into (``ids``: the generated list ref; ``keys``: per-token PRNG
+    # states, index-aligned with ``ids``) so the serving layer can stamp
+    # resumable checkpoints onto SSE frames without touching the queue
+    # payload type.
+    journaled: bool = False
+    export: dict | None = None
 
 
 class PagedScheduler(AdmissionMixin, DecodeMixin, ConstraintMixin):
@@ -335,6 +345,25 @@ class PagedScheduler(AdmissionMixin, DecodeMixin, ConstraintMixin):
             "FEI_TPU_KV_CDN", "1"
         ).strip().lower() not in ("0", "off", "false")
         self._cas_salt: bytes | None = None  # lazy: needs the live pool
+        # crash-consistent session journal (engine/journal.py): admission
+        # / delivered-token / terminal records appended off the hot path
+        # by a background writer. Empty FEI_TPU_JOURNAL_DIR = off (crash
+        # coverage stays cooperative: drain snapshots only).
+        self._journal = None
+        _jdir = _os.environ.get("FEI_TPU_JOURNAL_DIR", "").strip()
+        if _jdir:
+            from fei_tpu.engine.journal import SessionJournal
+
+            self._journal = SessionJournal(
+                _jdir,
+                sync=(
+                    _os.environ.get("FEI_TPU_JOURNAL_SYNC", "batch")
+                    .strip().lower() or "batch"
+                ),
+                segment_bytes=int(_os.environ.get(
+                    "FEI_TPU_JOURNAL_SEGMENT_BYTES", str(4 << 20)
+                )),
+            )
         # control-plane closures (KV export/import for migration) run on
         # the loop thread between dispatches — the donated pool is
         # single-owner state and must never race a dispatch
@@ -349,15 +378,23 @@ class PagedScheduler(AdmissionMixin, DecodeMixin, ConstraintMixin):
         logit_mask_fn: Callable[[list[int]], np.ndarray | None] | None = None,
         grammar=None,
         grammar_trigger: str | None = None,
+        export: dict | None = None,
+        resume: dict | None = None,
     ) -> Iterator[int]:
         """Submit a request and yield its tokens as they decode.
 
         Closing the iterator (or abandoning it to GC) cancels the request
         and returns its pages/slot to the pool — an abandoned stream can
-        never wedge the engine (round-1 advisory)."""
+        never wedge the engine (round-1 advisory).
+
+        ``export`` (a caller-owned dict) receives live resume state per
+        delivered token (see _Seq.export); ``resume`` is a restore dict
+        (``generated`` + optional ``resume_key``) teacher-forcing an
+        already-delivered suffix — the fleet resurrection path."""
         seq = self.submit(
             prompt_ids, gen, logit_mask_fn,
             grammar=grammar, grammar_trigger=grammar_trigger,
+            _restore=resume, _export=export,
         )
         yield from self.drain(seq)
 
@@ -378,6 +415,7 @@ class PagedScheduler(AdmissionMixin, DecodeMixin, ConstraintMixin):
         self, prompt_ids, gen, logit_mask_fn=None,
         grammar=None, grammar_trigger: str | None = None,
         _restore: dict | None = None,
+        _export: dict | None = None,
     ) -> _Seq:
         """``grammar`` (a TokenGrammar) runs DEVICE-NATIVE: the DFA mask is
         computed inside the compiled step from per-slot states — unlike
@@ -468,10 +506,30 @@ class PagedScheduler(AdmissionMixin, DecodeMixin, ConstraintMixin):
             key = _restore.get("resume_key")
             if key is not None:
                 seq.resume_key = np.asarray(key, dtype=np.uint32)
+            elif seq.generated:
+                # no recorded chain state (a resurrection that died inside
+                # its replay window): rebuild it. The per-slot chain is
+                # PRNGKey(seed) split once at prefill and once per decode
+                # step, so the state after k delivered tokens is exactly k
+                # splits — reproducible on any host.
+                rng = jax.random.PRNGKey(int(getattr(gen, "seed", 0) or 0))
+                for _ in range(len(seq.generated)):
+                    rng = jax.random.split(rng)[0]
+                seq.resume_key = np.asarray(rng, dtype=np.uint32)
             seq.replay = bool(seq.generated)
             rem = _restore.get("deadline_remaining_s")
             if rem is not None:
                 seq.deadline = seq.t_queued + float(rem)
+        if _export is not None:
+            seq.export = _export
+            # ``ids`` is the LIVE generated list (appends are atomic under
+            # the GIL); ``keys`` stays index-aligned with it — replayed
+            # tokens carry no per-token state except the final resume key
+            _export["ids"] = seq.generated
+            keys = _export.setdefault("keys", [])
+            if seq.generated:
+                keys.extend([None] * (len(seq.generated) - 1))
+                keys.append(self._key_list(seq.resume_key))
         METRICS.incr("scheduler.requests_submitted")
         appended = False
         if grammar is not None:
@@ -547,6 +605,10 @@ class PagedScheduler(AdmissionMixin, DecodeMixin, ConstraintMixin):
             self._settle_caps(
                 victims, shed, tenant, priority, depth, arrival=seq
             )
+        # WAL admission record LAST — after every shed-raise point above,
+        # so a journaled rid is exactly an accepted request and recovery
+        # can never resurrect a request the caller saw rejected
+        self._journal_admit(seq)
         # full gauge refresh on submit (not just queue depth): /metrics
         # must reflect pool saturation even while nothing is finishing
         self._update_sched_gauges()
@@ -622,6 +684,7 @@ class PagedScheduler(AdmissionMixin, DecodeMixin, ConstraintMixin):
             # _trace_finish counts scheduler.requests_shed: an evicted
             # victim is a shed request like any backpressure rejection
             self._trace_finish(v, "shed")
+            self._journal_end(v, "shed")
             METRICS.incr(f"tenant.{v.tenant}.sheds")
             FLIGHT.event(
                 "queue_evict", rid=v.rid, priority=v.priority,
@@ -685,6 +748,7 @@ class PagedScheduler(AdmissionMixin, DecodeMixin, ConstraintMixin):
                         self._kv_tier.unpin(seq.cas_key)
                         seq.cas_key = None
                 self._trace_finish(seq, "cancelled")
+                self._journal_end(seq, "cancelled")
                 return
             seq.cancelled = True
         self._wake.set()
@@ -718,6 +782,10 @@ class PagedScheduler(AdmissionMixin, DecodeMixin, ConstraintMixin):
         self._wake.set()
         if thread is not None and thread.is_alive():
             thread.join(timeout=30)
+        if self._journal is not None:
+            # flush, don't close: a submit() after close() reopens the
+            # scheduler and must keep journaling into the live segment
+            self._journal.flush()
 
     # -- control-plane closures on the loop thread --------------------------
 
@@ -920,10 +988,13 @@ class PagedScheduler(AdmissionMixin, DecodeMixin, ConstraintMixin):
         pages = self.engine._allocator.pages_for(slot)
         return np.asarray(build_block_table([pages], width))[0]
 
-    def _deliver(self, seq: _Seq, t: int) -> None:
+    def _deliver(self, seq: _Seq, t: int, key=None) -> None:
         """Handle one sampled token for an armed sequence — grammar walk,
         stop handling, emission, completion. Shared by the admission first
-        token and every decode step.
+        token and every decode step. ``key`` is the slot's post-step PRNG
+        state (host uint32[2]) when a consumer needs it (journal/export);
+        None otherwise — the decode paths skip the device transfer
+        entirely when nothing armed wants per-token keys.
 
         Delivery is a request-scoped failure domain: the grammar/scanner
         walk, the fallback masker advance, and emission are all host-side
@@ -934,7 +1005,7 @@ class PagedScheduler(AdmissionMixin, DecodeMixin, ConstraintMixin):
         the loop's _fail_all classification."""
         try:
             FAULTS.check("delivery.detok", seq=seq, rid=seq.rid)
-            self._deliver_inner(seq, t)
+            self._deliver_inner(seq, t, key)
         except BaseException as exc:  # noqa: BLE001
             if isinstance(exc, DeviceError) or not self._pool_intact():
                 raise
@@ -948,10 +1019,11 @@ class PagedScheduler(AdmissionMixin, DecodeMixin, ConstraintMixin):
         survive."""
         seq.out.put(exc)
         self._trace_finish(seq, "failed")
+        self._journal_end(seq, "failed")
         METRICS.incr("scheduler.requests_failed_isolated")
         self._finish(seq)
 
-    def _deliver_inner(self, seq: _Seq, t: int) -> None:
+    def _deliver_inner(self, seq: _Seq, t: int, key=None) -> None:
         if seq.grammar is not None:
             emit, done = self._grammar_advance(seq, t)
         else:
@@ -966,6 +1038,14 @@ class PagedScheduler(AdmissionMixin, DecodeMixin, ConstraintMixin):
                     "ttft_seconds", time.perf_counter() - seq.t_queued
                 )
             seq.generated.append(t)
+            # journal + export BEFORE out.put publishes the token: the
+            # consumer must never observe token n while its resume state
+            # (keys[n-1] / the WAL tok record) is still missing — the
+            # commit point of the crash-consistency contract
+            if seq.export is not None:
+                seq.export["keys"].append(self._key_list(key))
+            if seq.journaled:
+                self._journal.token(seq.rid, t, self._key_list(key))
             seq.out.put(t)
             # weighted-fair service accounting: admission picks the
             # backlogged tenant with the least served-tokens/weight
@@ -1023,6 +1103,9 @@ class PagedScheduler(AdmissionMixin, DecodeMixin, ConstraintMixin):
         if slot >= 0 and self._slots[slot] is seq:
             self._evict_slot(slot)
         self._trace_finish(seq, "cancelled" if seq.cancelled else "completed")
+        self._journal_end(
+            seq, "cancelled" if seq.cancelled else "completed"
+        )
         self._update_sched_gauges()
         seq.out.put(_DONE)
 
@@ -1048,6 +1131,83 @@ class PagedScheduler(AdmissionMixin, DecodeMixin, ConstraintMixin):
         self._pool = self._evict_jit(self._pool, jnp.int32(slot))
         self.engine._allocator.free(slot)
         self._slots[slot] = None
+
+    # -- crash-consistency journal hooks -------------------------------------
+
+    @staticmethod
+    def _key_list(key) -> list[int] | None:
+        """A PRNG key as a JSON-portable [hi, lo] int list (None passes
+        through) — the WAL / SSE wire form of a uint32[2] key."""
+        if key is None:
+            return None
+        return [int(x) for x in np.asarray(key).reshape(-1).tolist()]
+
+    def _want_token_keys(self) -> bool:
+        """True when any armed slot needs per-token PRNG states on the
+        host (journaled or exporting) — gates the step-key device
+        transfer so unjournaled serving pays nothing for the feature."""
+        return any(
+            s is not None and not s.finished
+            and (s.journaled or s.export is not None)
+            for s in self._slots
+        )
+
+    def _journal_admit(self, seq: _Seq) -> None:
+        """WAL admission record — called at the end of submit(), after
+        every shed-raise point. Constrained requests (grammar / mask
+        closures) hold process-local state and stay un-journaled,
+        mirroring _snapshot_seq's portability rule."""
+        j = self._journal
+        if j is None or seq.finished:
+            return
+        if (
+            seq.grammar is not None
+            or seq.mask_fn is not None
+            or seq.gscanner is not None
+            or seq.gfallback_state is not None
+        ):
+            return
+        from dataclasses import asdict
+
+        from fei_tpu.engine.journal import deadline_epoch
+        from fei_tpu.parallel.mesh import mesh_geometry
+
+        gen = asdict(seq.gen)
+        gen["stop_token_ids"] = list(gen.get("stop_token_ids") or ())
+        rec = {
+            "t": "admit",
+            "rid": seq.rid,
+            "prompt_ids": [int(t) for t in seq.prompt_ids],
+            "gen": gen,
+            "mesh": mesh_geometry(self.engine.mesh),
+            "tenant": seq.tenant,
+            "priority": seq.priority,
+        }
+        if seq.deadline:
+            # wall-clock, not perf_counter: the deadline must survive a
+            # process restart to mean anything at recovery time
+            rec["deadline_epoch"] = deadline_epoch(
+                seq.deadline - time.perf_counter()
+            )
+        if seq.generated:
+            # a resumed admission (warm restart / resurrection) journals
+            # its already-delivered suffix so recovery composes across
+            # repeated crashes without replaying the dead WAL's records
+            rec["generated"] = [int(t) for t in seq.generated]
+            rec["resume_key"] = self._key_list(seq.resume_key)
+        j.admit(rec)
+        seq.journaled = True
+
+    def _journal_end(self, seq: _Seq, reason: str) -> None:
+        """WAL terminal record (idempotent per request). A journaled rid
+        with no terminal record is exactly the set recovery re-admits —
+        so EVERY exit path (finish, fail, shed, cancel, drain, device
+        loss) must land here, or the next boot resurrects a ghost."""
+        j = self._journal
+        if j is None or not seq.journaled:
+            return
+        seq.journaled = False
+        j.finish(seq.rid, reason)
 
     def _trace_finish(self, seq: _Seq, status: str) -> None:
         """Terminal trace event + lifecycle counter (idempotent — the
@@ -1122,6 +1282,7 @@ class PagedScheduler(AdmissionMixin, DecodeMixin, ConstraintMixin):
         for s in waiting:
             s.finished = True
             self._trace_finish(s, "failed")
+            self._journal_end(s, "failed")
             s.out.put(exc)
         self._admitting = None
         for s in list(self._slots):
@@ -1175,6 +1336,7 @@ class PagedScheduler(AdmissionMixin, DecodeMixin, ConstraintMixin):
         for s in doomed:
             s.finished = True
             self._trace_finish(s, "failed")
+            self._journal_end(s, "failed")
             s.out.put(exc)
 
     # -- memory pressure: preemption + pressure-aware allocation -------------
@@ -1480,6 +1642,7 @@ class PagedScheduler(AdmissionMixin, DecodeMixin, ConstraintMixin):
                     retry_after_s=self.retry_after_s,
                 ))
                 self._trace_finish(s, "failed")
+                self._journal_end(s, "failed")
             else:
                 snaps.append(snap)
                 FLIGHT.event(
@@ -1491,6 +1654,10 @@ class PagedScheduler(AdmissionMixin, DecodeMixin, ConstraintMixin):
                     retry_after_s=self.retry_after_s,
                 ))
                 self._trace_finish(s, "snapshotted")
+                # terminal in the JOURNAL too: the drain snapshot now owns
+                # this session — without this, a warm restart would re-admit
+                # it twice (once from the snapshot file, once from the WAL)
+                self._journal_end(s, "snapshotted")
             s.out.put(_DONE)
         if snaps and self._drain_dir:
             from fei_tpu.engine import checkpoint
@@ -1503,6 +1670,10 @@ class PagedScheduler(AdmissionMixin, DecodeMixin, ConstraintMixin):
                 )
             except Exception as exc:  # noqa: BLE001
                 log.error("drain snapshot persistence failed: %r", exc)
+        if self._journal is not None:
+            # the terminal records above must be durable before the old
+            # process exits, or the next boot resurrects drained ghosts
+            self._journal.flush()
         self._update_sched_gauges()
         log.info(
             "drain finalized: %d request(s) snapshotted (%d preempted "

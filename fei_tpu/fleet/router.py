@@ -61,6 +61,17 @@ Failure handling:
 - When *no* replica looks usable, the router force-probes the whole set
   once before shedding 503 — a stale cache entry must not turn a
   transient blip into an outage.
+- **Mid-stream resurrection** — each streamed content frame carries an
+  ``fei`` extension (delivered token ids + the PRNG resume key) from
+  the serving layer; the router keeps a per-stream ledger of them. When
+  a replica dies AFTER tokens flowed (kill -9, dropped socket, stream
+  closed without a finish), the ledger re-submits the request to a
+  survivor with ``body["resume"]`` teacher-forcing the delivered
+  suffix, suppresses the byte-identical replayed prefix, and splices
+  the survivor's tail into the client's stream
+  (``router.resurrections`` / ``router.resurrection_replayed_tokens``).
+  Tool-grammar turns never resurrect (they are never journaled); with
+  no survivor the failure degrades to the old error-frame contract.
 
 ``rolling_restart()`` sequences drain → warm-restart across the set one
 replica at a time, keeping the rest in rotation: zero accepted requests
@@ -205,6 +216,10 @@ class Router:
             # a health failure; the breaker decides how many to forgive
             log.debug("probe %s failed: %r", rid, exc)
             st.healthy = False
+            # dead, not draining: an unreachable replica must charge the
+            # breaker and surface as DOWN — a stale draining flag from a
+            # graceful exit would dress a kill -9 up as orderly
+            st.draining = False
             self._note_failure(rid)
             return False
         payload = payload if isinstance(payload, dict) else {}
@@ -732,9 +747,15 @@ class Router:
     # -- streaming ----------------------------------------------------------
 
     def stream_chat(self, body: dict, headers: dict | None = None):
-        """SSE frames, with replica failover only BEFORE the first
-        content frame — once tokens flowed, a failure is an error frame
-        (exactly the single-replica contract). Yields frames."""
+        """SSE frames with replica failover on BOTH sides of the first
+        content frame. Before tokens flow, a failure retries on an
+        untried replica (classic forward retry). After tokens flowed,
+        the delivered-state ledger resurrects the session on a survivor
+        (``_resurrect``) — the replayed prefix is suppressed so the
+        client stream stays byte-identical; only when no survivor can
+        take the session does the failure become an error frame (the
+        old single-replica contract, now the floor rather than the
+        ceiling). Yields frames."""
         METRICS.incr("router.requests")
         headers = dict(headers or {})
         t0 = time.monotonic()
@@ -818,16 +839,190 @@ class Router:
                 continue
             self._state[rid].fails = 0
             self._remember(key, rid)
-            yield from buffered
-            yield from gen
+            # Post-commit streaming with mid-stream resurrection: every
+            # emitted frame updates a delivered-state ledger (content
+            # chars, absolute token ids + latest PRNG resume key off the
+            # per-frame ``fei`` extension). If the serving replica dies
+            # after tokens flowed — transport exception, stream closed
+            # without a finish, or a mid-stream server_error frame — the
+            # ledger teacher-forces the delivered suffix onto a survivor
+            # and the replayed prefix is suppressed, so the client sees
+            # one uninterrupted, byte-identical stream.
+            st = {"id": None, "chars": 0, "toks": [], "key": None,
+                  "resumable": False, "tools": False, "finished": False}
+            cur = rid
+            src = _chain_frames(buffered, gen)
+            skip = 0
+            dead: set[str] = set()
+            while True:
+                died: BaseException | None = None
+                try:
+                    yield from self._tracked(st, src, skip_chars=skip,
+                                             resumed=skip > 0)
+                    if st["finished"]:
+                        break
+                    died = EngineError(
+                        f"replica {cur} closed the stream mid-generation"
+                    )
+                except Exception as exc:  # noqa: BLE001 — any mid-stream
+                    # failure is a dead/unreachable replica; the ledger
+                    # decides whether the session can move
+                    died = exc
+                self._state[cur].healthy = False
+                self._note_failure(cur)
+                dead.add(cur)
+                remaining = None
+                if budget is not None:
+                    remaining = budget - (time.monotonic() - t0)
+                nxt = self._resurrect(st, dead, body, headers, key,
+                                      remaining)
+                if nxt is None:
+                    yield (b"data: " + json.dumps({"error": {
+                        "message": (
+                            f"replica {cur}: stream died mid-generation "
+                            f"({type(died).__name__}: {died}) and the "
+                            "session could not be resumed elsewhere"
+                        ),
+                        "type": "server_error"}}).encode() + b"\n\n")
+                    yield b"data: [DONE]\n\n"
+                    return
+                cur, src = nxt
+                skip = st["chars"]
             # stream finished: if a prefill-heavy replica served it,
             # push the warm prefix to decode capacity for the next turn
-            self._handoff(key, rid, body)
+            self._handoff(key, cur, body)
             return
         METRICS.incr("router.sheds")
         yield (b"data: " + json.dumps({"error": last_err}).encode()
                + b"\n\n")
         yield b"data: [DONE]\n\n"
+
+    def _tracked(self, st: dict, frames, skip_chars: int = 0,
+                 resumed: bool = False):
+        """Yield one replica's SSE frames to the client while keeping the
+        delivered-state ledger ``st`` current: cumulative content chars,
+        the absolute delivered token ids and latest PRNG resume key (off
+        the serving layer's per-frame ``fei`` extension), tool-call and
+        finish markers. For a resumed source the first ``skip_chars``
+        content chars are the failover replay — they already reached the
+        client from the dead replica, so whole-replay frames are
+        swallowed, the straddling frame is rewritten, the duplicate
+        role preamble drops, and every frame re-stamps the original
+        stream id. Raises on a mid-stream server_error frame when the
+        session is resumable (the caller's resurrection loop owns it)."""
+        replayed = 0
+        for chunk in frames:
+            info = _parse_sse(chunk)
+            if info is None:
+                if b"[DONE]" in chunk:
+                    st["finished"] = True
+                yield chunk
+                continue
+            err = info.get("error")
+            if err:
+                if (st["resumable"] and not st["tools"]
+                        and str(err.get("type")) == "server_error"):
+                    raise EngineError(
+                        f"mid-stream server error: {err.get('message')}"
+                    )
+                yield chunk
+                continue
+            if st["id"] is None and info.get("id"):
+                st["id"] = info["id"]
+            fei = info.get("fei")
+            if isinstance(fei, dict):
+                st["toks"].extend(int(t) for t in (fei.get("toks") or []))
+                if fei.get("key") is not None:
+                    st["key"] = fei["key"]
+                st["resumable"] = True
+            choice = (info.get("choices") or [{}])[0]
+            delta = choice.get("delta") or {}
+            if delta.get("tool_calls"):
+                st["tools"] = True
+            if choice.get("finish_reason"):
+                st["finished"] = True
+            content = delta.get("content")
+            dirty = False
+            if resumed:
+                if "role" in delta and not content:
+                    continue  # duplicate preamble: the client has one
+                if st["id"] is not None and info.get("id") != st["id"]:
+                    info["id"] = st["id"]
+                    dirty = True
+            if content and replayed < skip_chars:
+                take = min(skip_chars - replayed, len(content))
+                replayed += take
+                content = content[take:]
+                delta = {k: v for k, v in delta.items() if k != "content"}
+                if content:
+                    delta["content"] = content
+                info["choices"][0]["delta"] = delta
+                dirty = True
+                if not content and not choice.get("finish_reason"):
+                    continue  # wholly-replayed frame
+            if content:
+                st["chars"] += len(content)
+            if dirty:
+                chunk = b"data: " + json.dumps(info).encode() + b"\n\n"
+            yield chunk
+
+    def _resurrect(self, st: dict, dead: set, body: dict, headers: dict,
+                   key: str | None, remaining: float | None):
+        """Teacher-force a dead replica's delivered suffix onto a
+        survivor. Returns ``(rid, frames)`` with the ledger reset for the
+        resumed stream's absolute re-export, or None when the session
+        cannot move: a tool-grammar turn (never journaled), no ``fei``
+        extension observed (non-engine provider), an expired deadline,
+        or no survivor that will take it."""
+        if st["tools"] or not st["resumable"] or not st["toks"]:
+            return None
+        if remaining is not None and remaining <= 0:
+            METRICS.incr("router.deadline_expired")
+            return None
+        body2 = {k: v for k, v in body.items() if k != "resume"}
+        body2["resume"] = {"generated": [int(t) for t in st["toks"]],
+                           "resume_key": st["key"]}
+        fwd = dict(headers)
+        if remaining is not None:
+            fwd["X-FEI-Deadline-S"] = f"{remaining:.3f}"
+        tried = set(dead)
+        for _ in range(self.retries + 1):
+            rid = self._pick(key, exclude=tried)
+            if rid is None:
+                rid = self._pick(key, exclude=tried, force=True)
+            if rid is None:
+                return None
+            tried.add(rid)
+            try:
+                FAULTS.check("router.forward", replica=rid)
+                buffered, gen, err = self._try_stream(rid, body2, fwd)
+            except Exception as exc:  # noqa: BLE001 — a survivor that
+                # cannot take the session is just another dead end
+                log.warning("resurrection on %s failed: %r", rid, exc)
+                self._state[rid].healthy = False
+                self._note_failure(rid)
+                continue
+            if err is not None:
+                log.warning("resurrection on %s declined: %s", rid, err)
+                continue
+            METRICS.incr("router.resurrections")
+            METRICS.incr("router.resurrection_replayed_tokens",
+                         len(st["toks"]))
+            FLIGHT.event("router_resurrect", replica=rid,
+                         replayed=len(st["toks"]))
+            log.warning(
+                "resurrecting session on %s (%d delivered tokens "
+                "teacher-forced)", rid, len(st["toks"]),
+            )
+            self._remember(key, rid)
+            # the resumed stream re-exports the session from token 0
+            # (replay included), so the ledger rebuilds absolutely —
+            # a second crash resumes from the rebuilt ledger
+            st["toks"] = []
+            st["key"] = None
+            st["resumable"] = False
+            return rid, _chain_frames(buffered, gen)
+        return None
 
     def _try_stream(self, rid: str, body: dict, headers: dict):
         """Start a stream and pull frames until the replica committed
@@ -931,6 +1126,12 @@ class Router:
                             "restart", rid)
         METRICS.incr("router.rolling_restarts")
         return report
+
+
+def _chain_frames(buffered, gen):
+    """Replay the commit-probe's buffered frames, then the live tail."""
+    yield from buffered
+    yield from gen
 
 
 def _parse_sse(chunk: bytes) -> dict | None:

@@ -270,6 +270,36 @@ METRIC_REGISTRY: dict[str, tuple[str, str]] = {
                                 "Pre-warm pushes that failed (replica "
                                 "unreachable, refused, or corrupt blob); "
                                 "the replica serves cold instead."),
+    "journal.appends": ("counter",
+                        "Records appended to the crash-consistency "
+                        "session journal (admissions, delivered tokens, "
+                        "terminals)."),
+    "journal.bytes": ("counter",
+                      "Bytes written to the session journal (framing "
+                      "included)."),
+    "journal.fsyncs": ("counter",
+                       "fsync() calls issued by the journal writer "
+                       "(FEI_TPU_JOURNAL_SYNC=batch coalesces; =always "
+                       "is one per record)."),
+    "journal.recovered_sessions": ("counter",
+                                   "Unfinished sessions re-admitted from "
+                                   "the journal at warm restart "
+                                   "(byte-identical replay)."),
+    "journal.torn_records": ("counter",
+                             "Half-appended journal records discarded at "
+                             "recovery (the crash landed mid-write; "
+                             "committed tokens are never among them)."),
+    "engine.crash_recoveries": ("counter",
+                                "Warm restarts that found and replayed "
+                                "at least one journaled session."),
+    "router.resurrections": ("counter",
+                             "Mid-stream sessions moved to a survivor "
+                             "after their replica died with tokens "
+                             "already delivered."),
+    "router.resurrection_replayed_tokens": (
+        "counter",
+        "Delivered tokens teacher-forced into a survivor during "
+        "resurrection (the client never sees them twice)."),
     "engine.compiles": ("counter",
                         "Jit program compilations observed (first build "
                         "per program signature — warmup cost)."),

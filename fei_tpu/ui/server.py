@@ -139,6 +139,11 @@ def _gen_overrides(body: dict, headers: dict | None = None) -> dict:
         over["min_p"] = min(max(float(body["min_p"]), 0.0), 1.0)
     if body.get("seed") is not None:
         over["seed"] = int(body["seed"])
+    if body.get("ignore_eos") is not None:
+        # non-OpenAI extension (vLLM-style): decode the full max_tokens
+        # budget — benches and the chaos crash smoke need streams long
+        # enough to kill mid-flight regardless of what the model samples
+        over["ignore_eos"] = bool(body["ignore_eos"])
     deadlines = []
     if body.get("deadline_s") is not None:  # non-OpenAI extension
         dl = max(0.0, float(body["deadline_s"]))
@@ -384,7 +389,33 @@ class ServeAPI:
             "tools": _from_openai_tools(body.get("tools")),
             "max_tokens": mt,
             **self._overrides_kw(body, headers),
+            **self._resume_kw(body),
         }
+
+    def _resume_kw(self, body: dict) -> dict:
+        """Fleet-router resurrection extension: ``"resume": {"generated":
+        [ids...], "resume_key": [a, b] | null}`` teacher-forces a dead
+        replica's delivered suffix so this replica's stream replays it
+        byte-identically. Only providers that own a paged engine support
+        it; others reject loudly (silently restarting from token 0 would
+        duplicate the user-visible stream)."""
+        raw = body.get("resume")
+        if raw is None:
+            return {}
+        if not getattr(self.provider, "supports_resume", False):
+            raise ValueError("resume is not supported by this provider")
+        if not isinstance(raw, dict):
+            raise ValueError("resume must be an object")
+        gen = raw.get("generated") or []
+        if not isinstance(gen, list):
+            raise ValueError("resume.generated must be a list of token ids")
+        resume: dict = {"generated": [int(t) for t in gen]}
+        key = raw.get("resume_key")
+        if key is not None:
+            if not isinstance(key, list) or not key:
+                raise ValueError("resume.resume_key must be a list of ints")
+            resume["resume_key"] = [int(x) for x in key]
+        return {"resume": resume}
 
     def _mesh_tag(self) -> str:
         """The backing engine's serving-mesh tag ('ms1' for single-chip
@@ -706,7 +737,7 @@ class ServeAPI:
         model = body.get("model") or self.model_name
         created = int(time.time())
 
-        def frame(delta: dict, finish=None) -> bytes:
+        def frame(delta: dict, finish=None, fei: dict | None = None) -> bytes:
             chunk = {
                 "id": rid,
                 "object": "chat.completion.chunk",
@@ -716,18 +747,55 @@ class ServeAPI:
                     {"index": 0, "delta": delta, "finish_reason": finish}
                 ],
             }
+            if fei is not None:
+                chunk["fei"] = fei
             return b"data: " + json.dumps(chunk).encode() + b"\n\n"
 
         yield frame({"role": "assistant"})
         resp = None
+        # Failover side-channel: the engine fills ``export`` in place with
+        # every delivered token id and its PRNG resume key; each content
+        # frame carries the ids delivered since the previous frame plus
+        # the PRNG state after the last of them as an ``fei`` extension,
+        # so the fleet router can resurrect this stream on a survivor
+        # byte-identically if this process dies mid-stream. OpenAI
+        # clients ignore the extra key.
+        export: dict | None = None
+        if getattr(self.provider, "supports_resume", False):
+            export = {}
+            kw = dict(kw, export=export)
+        sent_toks = 0
         try:
+            from fei_tpu.engine.faults import FAULTS
+
             msgs = kw.pop("messages")
             gen = self.provider.stream(msgs, **kw)
             while True:
                 try:
                     delta = next(gen)
                     if delta:
-                        yield frame({"content": delta})
+                        ext = None
+                        if export is not None and export.get("ids"):
+                            n = len(export["ids"])
+                            if n > sent_toks:
+                                keys = export.get("keys") or []
+                                ext = {
+                                    "toks": [
+                                        int(t) for t in
+                                        export["ids"][sent_toks:n]
+                                    ],
+                                    "key": (
+                                        keys[n - 1]
+                                        if n - 1 < len(keys) else None
+                                    ),
+                                }
+                                sent_toks = n
+                        yield frame({"content": delta}, fei=ext)
+                        # the hard-kill seam the chaos_crash stage arms:
+                        # dies AFTER the frame left the handler, so the
+                        # client-observed suffix is the worst case the
+                        # journal + resurrection must cover
+                        FAULTS.check("replica.crash", rid=rid)
                 except StopIteration as fin:
                     resp = fin.value
                     break
@@ -891,14 +959,23 @@ def main(argv: list[str] | None = None) -> int:
              provider.engine.cfg.name, args.host, server.port)
 
     # warm restart: re-admit requests a previous process snapshotted at
-    # drain. They decode to completion server-side (the old connections
-    # are gone; clients were told 503 + Retry-After), which primes the
-    # prefix cache for their retries and proves none were lost.
+    # drain, AND any sessions the crash journal (FEI_TPU_JOURNAL_DIR)
+    # recorded as admitted-but-unterminated — the previous process may
+    # have died with no cooperation at all (kill -9). Either way they
+    # decode to completion server-side (the old connections are gone;
+    # clients were told 503 + Retry-After or are being resurrected by
+    # the fleet router), which primes the prefix cache for retries and
+    # proves none were lost.
     drain_dir = os.environ.get("FEI_TPU_DRAIN_DIR", "")
     eng = getattr(provider, "engine", None)
-    if drain_dir and eng is not None:
+    has_journal = (
+        eng is not None
+        and getattr(getattr(eng, "_scheduler", None), "_journal", None)
+        is not None
+    )
+    if eng is not None and (drain_dir or has_journal):
         try:
-            restored = eng.warm_restart(drain_dir)
+            restored = eng.warm_restart(drain_dir or None)
         except Exception as exc:  # noqa: BLE001 — boot must survive a
             # corrupt snapshot file; the operator sees the log
             log.warning("warm restart failed: %r", exc)

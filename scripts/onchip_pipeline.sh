@@ -182,6 +182,20 @@ stage fleet_tests env FEI_TPU_TEST_PLATFORM=tpu python -m pytest \
 stage bench_fleet run_bench env FEI_TPU_BENCH_SUITE=fleet FEI_TPU_BENCH_SESSIONS=24 \
   FEI_TPU_BENCH_MAX_WAIT_S=300 python -u bench.py
 
+# 0d1b'. crash consistency (docs/ENGINE.md "Crash consistency" +
+# docs/FLEET.md): WAL framing/recovery + engine/router crash suites on
+# the device engines, then the kill -9 smoke and the MTTR bench. The
+# smoke pins JAX_PLATFORMS=cpu even on-chip: several serve subprocesses
+# cannot share one accelerator, and the WAL/resurrection contract under
+# test is host-side.
+stage journal_tests env FEI_TPU_TEST_PLATFORM=tpu python -m pytest \
+  tests/test_journal.py -q --timeout 300
+stage crash_recovery env FEI_TPU_TEST_PLATFORM=tpu python -m pytest \
+  tests/test_crash_recovery.py -q --timeout 900
+stage chaos_crash env JAX_PLATFORMS=cpu python -u scripts/crash_smoke.py
+stage bench_crash run_bench env FEI_TPU_BENCH_SUITE=crash \
+  FEI_TPU_BENCH_MAX_WAIT_S=300 python -u bench.py
+
 # 0d1c. tiered KV store ON-CHIP (docs/KV.md): spill/restore
 # byte-identity, demotion, corrupt fallback, migration round-trip and
 # role routing against real device dispatches; then the oversubscribed

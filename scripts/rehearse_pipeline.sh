@@ -208,6 +208,22 @@ stage fleet_tests -- python -m pytest tests/test_fleet.py -q --timeout 600
 stage bench_fleet --json -- env FEI_TPU_BENCH_SUITE=fleet \
   FEI_TPU_BENCH_SESSIONS=9 FEI_TPU_BENCH_ROUNDS=1 python -u bench.py
 
+# --- crash consistency (docs/ENGINE.md "Crash consistency" +
+# docs/FLEET.md "Mid-stream session resurrection"): the WAL framing/
+# recovery suite and the engine/router crash suite run FOR REAL, then
+# chaos_crash kill -9s real `fei serve` subprocesses mid-stream — the
+# router must resurrect each stream on a survivor byte-identically
+# (zero accepted-token loss) and a process rebooted on the dead
+# replica's journal dir must re-admit the torn session. Forced onto
+# CPU: several serve processes cannot share one accelerator, and the
+# contract under test is host-side. ----
+stage journal_tests -- python -m pytest tests/test_journal.py -q \
+  --timeout 300
+stage crash_recovery -- python -m pytest tests/test_crash_recovery.py -q \
+  --timeout 900
+stage chaos_crash -- env JAX_PLATFORMS=cpu python -u scripts/crash_smoke.py
+stage bench_crash --json -- env FEI_TPU_BENCH_SUITE=crash python -u bench.py
+
 # --- tiered KV store (docs/KV.md): the kv suite runs FOR REAL (spill/
 # restore byte-identity, demotion, corrupt fallback, migration
 # round-trip, role routing), then the oversubscribed park/resume smoke
