@@ -59,6 +59,12 @@ Suites (FEI_TPU_BENCH_SUITE):
                      dropped accepted streams (the zero-loss claim wants
                      0) and the journal-sync decode A/B
                      (disabled/batch/always tok/s)
+  reshard          — mesh-elastic recovery cost: catch-up latency for a
+                     torn journaled session recovered across a mesh
+                     shrink (tp2 -> single chip) vs on the same mesh vs
+                     cold re-prefill with no journal; extras carry
+                     replayed/restored token counts and per-leg
+                     byte-identity flags
 
 Knobs:
   FEI_TPU_BENCH_MODEL    (decode default llama3-8b — the BASELINE config #2
@@ -1423,6 +1429,173 @@ def bench_crash(model: str, n_tokens: int) -> int:
                  unit="ms", extra=extra)
 
 
+def bench_reshard(model: str, n_tokens: int) -> int:
+    """Mesh-elastic recovery cost: what does it take to get a torn
+    session streaming again on a DIFFERENT mesh?
+
+    Three legs, each measured as catch-up latency (time until the
+    recovered stream has delivered one token PAST the pre-crash point):
+
+    - shrink    — journal written by a tp2 engine, recovered on a
+                  single chip (the headline: the chip-died-and-the-
+                  replica-re-formed-smaller scene). Needs >= 2 devices;
+                  degrades to a same-mesh run with a note otherwise.
+    - same_mesh — journal written and recovered on the same single-chip
+                  geometry (the cross-mesh tax baseline).
+    - cold      — no journal at all: re-prefill the prompt and
+                  re-generate up to the same point (what recovery costs
+                  when you have nothing).
+
+    Extras carry per-leg first-frame latency, replayed/restored token
+    counts, the engine.cross_mesh_recoveries delta, and a per-leg
+    byte_identical flag (the zero-loss claim wants all true)."""
+    import shutil
+    import tempfile
+
+    import jax
+
+    from fei_tpu.engine.engine import GenerationConfig
+    from fei_tpu.utils.metrics import METRICS
+
+    budget = max(8, min(n_tokens, 16))
+    accept = 5  # tokens the client had before the crash
+    can_tp2 = len(jax.devices()) >= 2
+    work = tempfile.mkdtemp(prefix="fei-bench-reshard-")
+
+    def make(mesh: str | None, jdir: str | None):
+        overrides = {
+            "FEI_TPU_JOURNAL_DIR": jdir,
+            "FEI_TPU_JOURNAL_SYNC": "batch" if jdir else None,
+            "FEI_TPU_MESH": mesh,
+        }
+        old = {k: os.environ.get(k) for k in overrides}
+        for k, v in overrides.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        try:
+            return _make_engine(
+                model, max_seq_len=512, paged=True, batch_size=2,
+                page_size=16,
+            )
+        finally:
+            for k, v in old.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    gen = GenerationConfig(max_new_tokens=budget, temperature=0.0,
+                           ignore_eos=True)
+    warm = GenerationConfig(max_new_tokens=2, temperature=0.0,
+                            ignore_eos=True)
+    prompt: list | None = None
+    legs: dict[str, dict] = {}
+
+    def torn_journal(name: str, src_mesh: str | None) -> tuple[str, list]:
+        """Freeze a journal dir exactly as a kill -9 would leave it:
+        ``accept`` tokens delivered, flushed, copied before any
+        cooperative shutdown runs."""
+        nonlocal prompt
+        jdir = os.path.join(work, f"{name}-wal")
+        crash = os.path.join(work, f"{name}-dead")
+        src = make(src_mesh, jdir)
+        if prompt is None:
+            prompt = _prompt(src)[:32]
+        seq = src.scheduler.submit(prompt, gen)
+        pre = [seq.out.get() for _ in range(accept)]
+        assert src.scheduler._journal.flush()
+        shutil.copytree(jdir, crash)
+        src.close()
+        return crash, pre
+
+    def recover(name: str, dst_mesh: str | None, crash: str,
+                pre: list) -> None:
+        dst = make(dst_mesh, crash)
+        # same-shape warm-up so the leg times recovery (journal read +
+        # teacher-forced replay + decode), not XLA compilation: one
+        # plain stream, plus one restore-shaped submit to compile the
+        # replay path itself; both terminate cleanly so warm_restart
+        # never sees them
+        list(dst.scheduler.stream(prompt, warm))
+        wseq = dst.scheduler.submit(
+            prompt, warm,
+            _restore={"generated": list(pre[:2]), "resume_key": None},
+        )
+        list(dst.scheduler.drain(wseq))
+        c0 = METRICS.snapshot()["counters"]
+        t0 = time.perf_counter()
+        restored = dst.warm_restart()
+        toks: list = []
+        t_first = t_caught = None
+        for s in restored:
+            for t in dst.scheduler.drain(s):
+                toks.append(t)
+                now = time.perf_counter()
+                if t_first is None:
+                    t_first = now
+                if t_caught is None and len(toks) > len(pre):
+                    t_caught = now
+        c1 = METRICS.snapshot()["counters"]
+        dst.close()
+        legs[name] = {
+            "first_frame_ms": round(((t_first or t0) - t0) * 1000, 1),
+            "catchup_ms": round(((t_caught or t_first or t0) - t0) * 1000,
+                                1),
+            "restored_sessions": int(
+                c1.get("journal.recovered_sessions", 0)
+                - c0.get("journal.recovered_sessions", 0)),
+            "replayed_tokens": len(pre),
+            "cross_mesh_recoveries": int(
+                c1.get("engine.cross_mesh_recoveries", 0)
+                - c0.get("engine.cross_mesh_recoveries", 0)),
+            "byte_identical": toks[:len(pre)] == pre,
+        }
+        log(f"bench: reshard {name}: catchup={legs[name]['catchup_ms']}ms "
+            f"byte_identical={legs[name]['byte_identical']}")
+
+    # -- leg 1: tp2 -> single chip (the shrink) -----------------------------
+    src_mesh = "tp2" if can_tp2 else None
+    if not can_tp2:
+        log("bench: reshard: single device visible; shrink leg degrades "
+            "to a same-mesh run (note stamped in extras)")
+    crash, pre = torn_journal("shrink", src_mesh)
+    recover("shrink", None, crash, pre)
+
+    # -- leg 2: same mesh (the cross-mesh tax baseline) ---------------------
+    crash, pre = torn_journal("same_mesh", None)
+    recover("same_mesh", None, crash, pre)
+
+    # -- leg 3: cold re-prefill (no journal: the cost of having nothing) ----
+    cold = make(None, None)
+    list(cold.scheduler.stream(prompt, warm))
+    cold_gen = GenerationConfig(max_new_tokens=accept + 1, temperature=0.0,
+                                ignore_eos=True)
+    t0 = time.perf_counter()
+    toks = list(cold.scheduler.stream(prompt, cold_gen))
+    t_caught = time.perf_counter()
+    cold.close()
+    legs["cold"] = {
+        "catchup_ms": round((t_caught - t0) * 1000, 1),
+        "replayed_tokens": 0,
+        "restored_sessions": 0,
+        "byte_identical": toks[:accept] == pre,
+    }
+    log(f"bench: reshard cold: catchup={legs['cold']['catchup_ms']}ms")
+
+    shutil.rmtree(work, ignore_errors=True)
+    extra = {
+        "legs": legs,
+        "accepted_tokens_at_crash": accept,
+        "tp2_leg": "tp2" if can_tp2 else "degraded_ms1_single_device",
+        "all_byte_identical": all(v["byte_identical"]
+                                  for v in legs.values()),
+    }
+    return _emit(f"{_tag(model)}_reshard_shrink_catchup_ms",
+                 legs["shrink"]["catchup_ms"], unit="ms", extra=extra)
+
+
 def bench_kvtier(model: str, n_tokens: int) -> int:
     """Tiered KV store under heavy slot oversubscription + migration.
 
@@ -1892,18 +2065,20 @@ def main() -> int:
         os.environ["XLA_FLAGS"] = flags
         os.execv(sys.executable, [sys.executable] + sys.argv)
     if (
-        suite == "sharded"
+        suite in ("sharded", "reshard")
         and os.environ.get("FEI_TPU_SHARDED_READY") != "1"
         and os.environ.get("JAX_PLATFORMS", "") == "cpu"
     ):
         # the CPU rehearsal of the mesh ladder needs an 8-device host
         # mesh BEFORE jax initializes (same re-exec dance as federation);
-        # on a real TPU backend the ladder just uses the visible chips
+        # the reshard suite only needs 2 for its tp2 source leg; on a
+        # real TPU backend both just use the visible chips
         os.environ["FEI_TPU_SHARDED_READY"] = "1"
         import re as _re
 
         flags = os.environ.get("XLA_FLAGS", "")
-        flag = "--xla_force_host_platform_device_count=8"
+        count = 8 if suite == "sharded" else 2
+        flag = f"--xla_force_host_platform_device_count={count}"
         if "xla_force_host_platform_device_count" in flags:
             flags = _re.sub(
                 r"--xla_force_host_platform_device_count=\d+", flag, flags
@@ -1924,6 +2099,10 @@ def main() -> int:
     elif suite == "fleet":
         # two engines in one process: tiny keeps the burst about QoS
         # shape, not model weight; override with FEI_TPU_BENCH_MODEL
+        default_model = "tiny"
+    elif suite == "reshard":
+        # five engine boots across two meshes: the cost being measured
+        # is recovery machinery, not model weight
         default_model = "tiny"
     elif suite == "decode":
         # BASELINE config #2 gate scale: Llama-3-8B on ONE chip. int8
@@ -1974,6 +2153,8 @@ def main() -> int:
         return bench_fleet(model, n_tokens)
     if suite == "crash":
         return bench_crash(model, n_tokens)
+    if suite == "reshard":
+        return bench_reshard(model, n_tokens)
     if suite == "kvtier":
         return bench_kvtier(model, n_tokens)
     if suite == "kvcdn":

@@ -157,21 +157,28 @@ def restore_checkpoint(
 # differently-built binary after a deploy.
 
 _SNAPSHOT_FILE = "requests.json"
-# v1: {version, requests}; v2 adds the serving mesh geometry — resume
-# replays KV through the same collective layout it was produced on, so a
-# warm restart onto a different mesh must refuse instead of silently
-# breaking byte-identity
-_SNAPSHOT_VERSION = 2
-_LEGACY_VERSIONS = (1,)
+# v1: {version, requests}; v2 added the serving mesh geometry as a
+# RESTORE GATE; v3 demotes mesh to provenance and records page_size —
+# snapshots are host-side token state, and tp/dp serving is proven
+# token-identical to single-chip (tests/test_sharded_serving.py), so a
+# warm restart onto a DIFFERENT mesh replays byte-identically through
+# the teacher-forced resume path. page_size is the one geometry axis
+# restore still refuses (PageSizeMismatchError): it changes the paged
+# kernel's summation order, which cross-cuts byte-identity.
+_SNAPSHOT_VERSION = 3
+_LEGACY_VERSIONS = (1, 2)
 
 
 def save_request_snapshots(
-    directory: str, snaps: list[dict], mesh: dict | None = None
+    directory: str, snaps: list[dict], mesh: dict | None = None,
+    page_size: int | None = None,
 ) -> None:
     """Atomically persist drain-time request snapshots (tmp + rename, the
     same torn-write discipline as the pipeline reports). ``mesh`` is the
-    draining engine's serialized geometry (parallel.mesh.mesh_geometry);
-    None records the single-chip layout."""
+    draining engine's serialized geometry (parallel.mesh.mesh_geometry)
+    — provenance for operators and heterogeneous-fleet placement, not a
+    restore gate; None records the single-chip layout. ``page_size`` is
+    the draining pool's page size — the one value restore gates on."""
     import json
 
     from fei_tpu.parallel.mesh import mesh_geometry
@@ -184,6 +191,8 @@ def save_request_snapshots(
         "mesh": mesh if mesh is not None else mesh_geometry(None),
         "requests": snaps,
     }
+    if page_size is not None:
+        payload["page_size"] = int(page_size)
     try:
         with open(tmp, "w", encoding="utf-8") as f:
             json.dump(payload, f)
@@ -202,18 +211,28 @@ def save_request_snapshots(
 
 
 def load_request_snapshots(
-    directory: str, expect_mesh: dict | None = None
+    directory: str, expect_mesh: dict | None = None,
+    expect_page_size: int | None = None,
 ) -> list[dict]:
     """Load persisted request snapshots; [] when none were saved. A
     corrupt or future-versioned file raises CheckpointError — silently
     dropping accepted requests is the failure mode this exists to
-    prevent. ``expect_mesh`` (the restoring engine's geometry) refuses a
-    file drained on a different mesh: resumed KV must rebuild through the
-    same collective layout to stay byte-identical. Version-1 files carry
-    no geometry and are treated as single-chip drains."""
+    prevent.
+
+    Geometry: the recorded mesh is PROVENANCE — a file drained on tp2
+    restores onto tp1/tp4/anything (snapshots are host-side token state;
+    the cross-mesh parity proofs make the teacher-forced replay
+    byte-identical), so ``expect_mesh`` only drives the cross-mesh log
+    line. ``expect_page_size`` is the one gate left: a file drained
+    under a different page size raises ``PageSizeMismatchError`` (typed,
+    naming both sizes) because page size changes the paged kernel's
+    summation order. v1/v2 files predate the page_size field and are
+    accepted as-is — they were written by builds whose only page size
+    was the default."""
     import json
 
     from fei_tpu.parallel.mesh import mesh_geometry
+    from fei_tpu.utils.errors import PageSizeMismatchError
 
     path = os.path.join(directory, _SNAPSHOT_FILE)
     if not os.path.exists(path):
@@ -232,15 +251,28 @@ def load_request_snapshots(
             f"request snapshot version {version!r} in {path} "
             f"is not the supported version {_SNAPSHOT_VERSION}"
         )
+    saved_ps = data.get("page_size")
+    if (
+        expect_page_size is not None
+        and saved_ps is not None
+        and int(saved_ps) != int(expect_page_size)
+    ):
+        raise PageSizeMismatchError(
+            f"request snapshots in {path} were drained under KV "
+            f"page_size={saved_ps}, but this engine serves "
+            f"page_size={expect_page_size}; page size changes the paged "
+            "kernel's summation order, so a cross-page_size replay "
+            "cannot promise byte-identity — restore with the matching "
+            "page_size or resubmit the requests",
+            ours=int(expect_page_size), theirs=int(saved_ps),
+        )
     if expect_mesh is not None:
         saved = data.get("mesh") or mesh_geometry(None)
         if {k: int(v) for k, v in saved.items()} != expect_mesh:
-            raise CheckpointError(
-                f"request snapshots in {path} were drained on mesh "
-                f"{saved}, but this engine serves mesh {expect_mesh}; "
-                "warm restart onto a mismatched mesh would break "
-                "byte-identical resume — restore on the matching mesh "
-                "or resubmit the requests"
+            log.info(
+                "request snapshots in %s were drained on mesh %s; "
+                "restoring onto mesh %s via cross-mesh replay",
+                path, saved, expect_mesh,
             )
     return list(data.get("requests", []))
 

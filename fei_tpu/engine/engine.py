@@ -606,7 +606,18 @@ class InferenceEngine:
         Each resumed request replays its already-delivered tokens to the
         fresh consumer, so the stream is byte-identical to the
         uninterrupted run. Returns the resubmitted sequence handles
-        (stream each via ``scheduler.drain(seq)``)."""
+        (stream each via ``scheduler.drain(seq)``).
+
+        Mesh elasticity (docs/ENGINE.md "Crash consistency"): both
+        sources restore across UNEQUAL meshes — a tp2 replica's
+        snapshots and journal recover on a single chip or a tp4 re-slice
+        (the common TPU failure: a chip or ICI link dies and the replica
+        re-forms smaller). Sessions are host-side token state and the
+        parity proofs make cross-mesh replay byte-identical; the one
+        geometry axis still refused is page_size
+        (``PageSizeMismatchError`` from the snapshot load; journaled
+        sessions recorded under a different page_size skip with an
+        ``engine.recovery_skipped`` counter + flight event)."""
         from fei_tpu.engine.checkpoint import (
             clear_request_snapshots,
             load_request_snapshots,
@@ -616,10 +627,12 @@ class InferenceEngine:
         seqs: list = []
         snaps: list[dict] = []
         if snapshot_dir:
-            # refuses (CheckpointError) when the snapshots were drained on
-            # a different mesh geometry than this engine serves
+            # raises PageSizeMismatchError for a snapshot file drained
+            # under a different KV page size — the one remaining gate;
+            # a different MESH restores via cross-mesh replay
             snaps = load_request_snapshots(
-                snapshot_dir, expect_mesh=mesh_geometry(self.mesh)
+                snapshot_dir, expect_mesh=mesh_geometry(self.mesh),
+                expect_page_size=self.page_size,
             )
             if snaps:
                 clear_request_snapshots(snapshot_dir)
@@ -629,6 +642,7 @@ class InferenceEngine:
         if journal is None:
             return seqs
         from fei_tpu.engine.journal import deadline_remaining
+        from fei_tpu.obs.flight import FLIGHT
 
         sessions, torn = journal.recover_and_clear()
         if not sessions and not torn:
@@ -636,26 +650,50 @@ class InferenceEngine:
         snap_rids = {s.get("rid") for s in snaps}
         mesh_now = mesh_geometry(self.mesh)
         recovered = 0
+        cross_mesh = 0
+
+        def skip(rid, reason: str, **tags) -> None:
+            # a dropped session must be VISIBLE: the silent-skip era made
+            # "recovery ran, session gone" indistinguishable from "never
+            # journaled" on a dashboard
+            METRICS.incr(f"engine.recovery_skipped.{reason}")
+            FLIGHT.event("recovery_skip", rid=rid, reason=reason, **tags)
+
         for sess in sessions:
             rid = sess.get("rid")
             if rid in snap_rids:
                 # the drain snapshot owns this session (belt and braces:
                 # _finalize_drain also journals a "snapshotted" terminal)
                 continue
-            saved = sess.get("mesh") or {}
-            if {k: int(v) for k, v in saved.items()} != mesh_now:
-                # byte-identical resume replays KV through the same
-                # collective layout it was produced on — skip, don't guess
+            saved_ps = sess.get("page_size")
+            if saved_ps is not None and int(saved_ps) != self.page_size:
+                # the one geometry axis that still refuses: page size
+                # changes the paged kernel's summation order
+                skip(rid, "page_size",
+                     theirs=int(saved_ps), ours=self.page_size)
                 log.warning(
-                    "journal session %s was served on mesh %s, not this "
-                    "engine's %s; dropping it (resubmit required)",
-                    rid, saved, mesh_now,
+                    "journal session %s was served under page_size=%s, "
+                    "not this engine's %s; dropping it (page size is the "
+                    "one geometry recovery cannot replay across)",
+                    rid, saved_ps, self.page_size,
                 )
                 continue
+            saved = sess.get("mesh") or {}
+            if {k: int(v) for k, v in saved.items()} != mesh_now:
+                # provenance only — cross-mesh sessions replay through
+                # the same teacher-forced machinery (the tp parity
+                # proofs are what make this byte-identical)
+                cross_mesh += 1
+                log.info(
+                    "journal session %s was served on mesh %s; "
+                    "recovering onto mesh %s via cross-mesh replay",
+                    rid, saved, mesh_now,
+                )
             rem = None
             if sess.get("deadline_epoch") is not None:
                 rem = deadline_remaining(sess["deadline_epoch"])
                 if rem <= 0:
+                    skip(rid, "deadline_expired")
                     log.info(
                         "journal session %s expired its deadline during "
                         "the outage; dropping it", rid,
@@ -679,11 +717,40 @@ class InferenceEngine:
         if recovered:
             METRICS.incr("journal.recovered_sessions", recovered)
             METRICS.incr("engine.crash_recoveries")
+        if cross_mesh:
+            METRICS.incr("engine.cross_mesh_recoveries", cross_mesh)
         log.info(
-            "journal: recovered %d session(s) (%d torn record(s) "
-            "discarded)", recovered, torn,
+            "journal: recovered %d session(s), %d across a mesh change "
+            "(%d torn record(s) discarded)", recovered, cross_mesh, torn,
         )
         return seqs
+
+    def kv_fingerprint(self) -> dict | None:
+        """The INVARIANT half of this engine's KV pool geometry (layers,
+        total kv heads, page_size, head_dim, dtype, quantized) — what
+        ``/health`` advertises so heterogeneous-fleet placement can see
+        which replicas exchange KV. None for dense (non-paged) engines.
+        Derived from config when the pool hasn't been built yet (the
+        pool is loop-thread state; a health probe must not race it)."""
+        if self._scheduler is None:
+            return None
+        from fei_tpu.kv.pagesio import config_fingerprint, pool_fingerprint
+
+        if self._pool is not None:
+            return pool_fingerprint(self._pool)
+        return config_fingerprint(
+            self.cfg, self.page_size, self.dtype, self.kv_quant
+        )
+
+    def kv_layout(self) -> dict | None:
+        """The LAYOUT half: how the kv-head extent is sliced over this
+        engine's tp axis. Provenance for placement — blobs reshard
+        across layouts, so a layout skew never blocks an exchange."""
+        if self._scheduler is None:
+            return None
+        from fei_tpu.kv.pagesio import shard_layout
+
+        return shard_layout(self.cfg.num_kv_heads, self.mesh)
 
     @property
     def scheduler(self):

@@ -938,8 +938,13 @@ class AdmissionMixin:
             return False
         if getattr(self.engine.cfg, "sliding_window", None):
             return False
-        from fei_tpu.kv.pagesio import pool_fingerprint, scatter_pages
+        from fei_tpu.kv.pagesio import (
+            canonicalize_arrays,
+            pool_fingerprint,
+            scatter_pages,
+        )
         from fei_tpu.obs.costmodel import account_kv_transfer
+        from fei_tpu.utils.errors import KVGeometryError
 
         alloc = self.engine._allocator
         ids = self._prefill_ids(seq)
@@ -957,14 +962,26 @@ class AdmissionMixin:
         if entry is None:
             return False
         need = alloc.pages_needed(n)
+        want = pool_fingerprint(self._pool)
         if (
             entry.n_tokens != n
             or entry.page_size != self.engine.page_size
             or entry.n_pages < need
-            or entry.fingerprint != pool_fingerprint(self._pool)
+            or entry.fingerprint != want
         ):
-            # stale (the sequence decoded past the spill) or from an
-            # incompatible pool: useless now and forever — drop it
+            # stale (the sequence decoded past the spill) or invariant-
+            # incompatible pool: useless now and forever — drop it. (A
+            # mere tp layout skew never lands here: the fingerprint is
+            # mesh-invariant and the arrays reshard below.)
+            tier.drop(seq.rid)
+            METRICS.incr("kv.fetch_fallbacks")
+            return False
+        try:
+            arrays = canonicalize_arrays(
+                entry.arrays, entry.layout, want["kv_heads"]
+            )
+        except KVGeometryError:
+            # partial head coverage (an exotic writer): replay instead
             tier.drop(seq.rid)
             METRICS.incr("kv.fetch_fallbacks")
             return False
@@ -976,7 +993,7 @@ class AdmissionMixin:
         with METRICS.span("kv_fetch"):
             self._pool = scatter_pages(
                 self._pool, pages[m:need],
-                {k: v[m:need] for k, v in entry.arrays.items()},
+                {k: v[m:need] for k, v in arrays.items()},
             )
         row = self._slot_row(slot)
         self._pool = self._arm_fn()(
@@ -1029,8 +1046,13 @@ class AdmissionMixin:
         tier = self._kv_tier
         if tier is None or not self._cas_enabled or self._prefix is None:
             return []
-        from fei_tpu.kv.pagesio import pool_fingerprint, scatter_pages
+        from fei_tpu.kv.pagesio import (
+            canonicalize_arrays,
+            pool_fingerprint,
+            scatter_pages,
+        )
         from fei_tpu.obs.costmodel import account_kv_transfer
+        from fei_tpu.utils.errors import KVGeometryError
 
         alloc = self.engine._allocator
         ids = self._prefill_ids(seq)
@@ -1050,16 +1072,32 @@ class AdmissionMixin:
                 entry = tier.fetch(key)  # kv.fetch faults fire here
                 if entry is None:
                     continue
+                want = pool_fingerprint(self._pool)
                 if (
                     entry.n_tokens != m * ps
                     or entry.page_size != ps
                     or entry.n_pages != m
-                    or entry.fingerprint != pool_fingerprint(self._pool)
+                    or entry.fingerprint != want
                 ):
-                    # a stale or peer-pushed blob that doesn't match this
-                    # pool is useless now and forever — drop, try shorter
+                    # a stale or invariant-incompatible blob is useless
+                    # now and forever — drop, try shorter. Content keys
+                    # salt with ONLY the invariant fingerprint, so a
+                    # peer on a DIFFERENT mesh still rendezvouses here
+                    # and its blob resheds below instead of dropping.
                     tier.drop(key)
                     continue
+                try:
+                    cas_arrays = canonicalize_arrays(
+                        entry.arrays, entry.layout, want["kv_heads"]
+                    )
+                except KVGeometryError:
+                    tier.drop(key)  # partial head coverage: prefill
+                    continue
+                if (
+                    entry.layout is not None
+                    and entry.layout.get("tp") != self._pool_tp()
+                ):
+                    METRICS.incr("kv.resharded_imports")
                 # the blob carries all m pages from position 0; the first
                 # ``have`` are already in the slot via the local match —
                 # allocate and scatter only the missing tail
@@ -1075,7 +1113,7 @@ class AdmissionMixin:
                     with METRICS.span("kv_fetch"):
                         self._pool = scatter_pages(
                             self._pool, got,
-                            {k: v[have:m] for k, v in entry.arrays.items()},
+                            {k: v[have:m] for k, v in cas_arrays.items()},
                         )
                     t1 = time.perf_counter()
                     full = list(prefix) + list(got)
@@ -1106,6 +1144,12 @@ class AdmissionMixin:
             )
         return []
 
+    def _pool_tp(self) -> int:
+        """The tp degree this pool is served under (layout half)."""
+        from fei_tpu.parallel.mesh import axis_size
+
+        return axis_size(self.engine.mesh, "tp")
+
     def _cas_publish(self, seq: _Seq, ids, pages) -> None:
         """Make a freshly admitted prompt's full-page prefix available
         under its content hash — to every other session through the
@@ -1128,7 +1172,11 @@ class AdmissionMixin:
         m = (len(ids) - 1) // ps
         if m <= 0:
             return
-        from fei_tpu.kv.pagesio import gather_pages, pool_fingerprint
+        from fei_tpu.kv.pagesio import (
+            gather_pages,
+            pool_fingerprint,
+            shard_layout,
+        )
         from fei_tpu.kv.tier import PageEntry
 
         try:
@@ -1140,10 +1188,11 @@ class AdmissionMixin:
             def make_entry() -> PageEntry:
                 with METRICS.span("kv_spill"):
                     arrays = gather_pages(self._pool, list(pages[:m]))
+                fp = pool_fingerprint(self._pool)
                 return PageEntry(
                     key=key, n_tokens=m * ps, page_size=ps,
-                    fingerprint=pool_fingerprint(self._pool),
-                    arrays=arrays,
+                    fingerprint=fp, arrays=arrays,
+                    layout=shard_layout(fp["kv_heads"], self.engine.mesh),
                 )
 
             tier.put_if_absent(key, make_entry)

@@ -886,7 +886,11 @@ class PagedScheduler(AdmissionMixin, DecodeMixin, ConstraintMixin):
 
     def _cas_keys(self, ids, n_pages: int) -> list[str]:
         """Content keys for the first 1..n_pages boundaries of ``ids``.
-        Loop thread only (reads the live pool's fingerprint once)."""
+        Loop thread only (reads the live pool's fingerprint once). The
+        salt hashes ONLY the invariant fingerprint half — mesh layout is
+        deliberately absent, so tp2 and tp4 replicas over the same model
+        derive identical ``cas:`` keys and the dedup tier stores one
+        copy per prefix instead of one per topology."""
         from fei_tpu.kv.content import content_keys, content_salt
         from fei_tpu.kv.pagesio import pool_fingerprint
 
@@ -1179,7 +1183,14 @@ class PagedScheduler(AdmissionMixin, DecodeMixin, ConstraintMixin):
             "rid": seq.rid,
             "prompt_ids": [int(t) for t in seq.prompt_ids],
             "gen": gen,
+            # provenance, not a recovery gate: snapshots/journal sessions
+            # are host-side token state and tp/dp serving is proven
+            # token-identical to single-chip, so a warm restart onto a
+            # DIFFERENT mesh replays them byte-identically. page_size is
+            # the one geometry axis recovery still refuses — it changes
+            # the paged kernel's summation order.
             "mesh": mesh_geometry(self.engine.mesh),
+            "page_size": int(self.engine.page_size),
             "tenant": seq.tenant,
             "priority": seq.priority,
         }
@@ -1437,7 +1448,11 @@ class PagedScheduler(AdmissionMixin, DecodeMixin, ConstraintMixin):
             # rolling-window slots release leading pages mid-decode;
             # spilled pages would misalign at scatter — replay covers
             return
-        from fei_tpu.kv.pagesio import gather_pages, pool_fingerprint
+        from fei_tpu.kv.pagesio import (
+            gather_pages,
+            pool_fingerprint,
+            shard_layout,
+        )
         from fei_tpu.kv.tier import PageEntry
         from fei_tpu.obs.costmodel import account_kv_transfer
 
@@ -1455,9 +1470,11 @@ class PagedScheduler(AdmissionMixin, DecodeMixin, ConstraintMixin):
             t0 = time.perf_counter()
             with METRICS.span("kv_spill"):
                 arrays = gather_pages(self._pool, pages)
+            fp = pool_fingerprint(self._pool)
             entry = PageEntry(
                 key=seq.rid, n_tokens=n, page_size=self.engine.page_size,
-                fingerprint=pool_fingerprint(self._pool), arrays=arrays,
+                fingerprint=fp, arrays=arrays,
+                layout=shard_layout(fp["kv_heads"], self.engine.mesh),
             )
             tier.put(seq.rid, entry)
             t1 = time.perf_counter()
@@ -1667,6 +1684,7 @@ class PagedScheduler(AdmissionMixin, DecodeMixin, ConstraintMixin):
                 checkpoint.save_request_snapshots(
                     self._drain_dir, snaps,
                     mesh=mesh_geometry(self.engine.mesh),
+                    page_size=self.engine.page_size,
                 )
             except Exception as exc:  # noqa: BLE001
                 log.error("drain snapshot persistence failed: %r", exc)
@@ -1706,10 +1724,11 @@ class PagedScheduler(AdmissionMixin, DecodeMixin, ConstraintMixin):
                 None if seq.resume_key is None
                 else [int(x) for x in np.asarray(seq.resume_key).tolist()]
             ),
-            # byte-identical resume replays KV through the same collective
-            # layout it was produced on — a different mesh (like a
-            # different page_size) changes summation order, so the
-            # geometry rides along and restore refuses a mismatch
+            # provenance: a snapshot is host-side token state, and the
+            # tp/dp parity proofs make cross-mesh replay byte-identical,
+            # so restore accepts any mesh. page_size still gates (it
+            # changes the paged kernel's summation order) — the v3
+            # snapshot file records it next to this.
             "mesh": mesh_geometry(self.engine.mesh),
             "gen": gen,
         }

@@ -122,7 +122,8 @@ class _ReplicaState:
     """Router-side view of one replica (health cache + breaker)."""
 
     __slots__ = ("fails", "ejected_until", "draining", "healthy",
-                 "queue_depth", "running", "slots", "last_probe", "role")
+                 "queue_depth", "running", "slots", "last_probe", "role",
+                 "kv_fingerprint", "kv_layout")
 
     def __init__(self):
         self.fails = 0
@@ -134,6 +135,13 @@ class _ReplicaState:
         self.slots = 1
         self.last_probe = 0.0      # monotonic; 0 = never probed
         self.role = "mixed"        # /health "role"; mixed until probed
+        # KV geometry halves off /health: the INVARIANT fingerprint
+        # (model shape / dtype / page size — what blobs and sessions can
+        # move between) and the tp shard layout (provenance; a layout
+        # skew resheds on import, it never blocks placement). None until
+        # probed, or for replicas that don't advertise geometry.
+        self.kv_fingerprint = None
+        self.kv_layout = None
 
     def load(self) -> float:
         return (self.queue_depth + self.running) / max(self.slots, 1)
@@ -229,6 +237,10 @@ class Router:
         st.running = int(payload.get("running") or 0)
         st.slots = int(payload.get("slots") or 1)
         st.role = str(payload.get("role") or "mixed")
+        fp = payload.get("kv_fingerprint")
+        st.kv_fingerprint = dict(fp) if isinstance(fp, dict) else None
+        lay = payload.get("kv_layout")
+        st.kv_layout = dict(lay) if isinstance(lay, dict) else None
         if st.healthy:
             # deliberately does NOT reset st.fails: a replica can answer
             # /health while failing real forwards, and a passing probe
@@ -367,13 +379,34 @@ class Router:
 
     # -- kv migration (warm-state mobility) ---------------------------------
 
+    @staticmethod
+    def _kv_compatible(a: dict | None, b: dict | None) -> bool:
+        """Can KV state move between replicas with these INVARIANT
+        fingerprints? Unknown (None — never probed, or a replica that
+        doesn't advertise geometry) is optimistic: the /kv endpoints
+        themselves are the authority and answer 409 on a real mismatch.
+        Layout is deliberately NOT consulted — a tp skew resheds on
+        import (docs/FLEET.md "Mesh elasticity")."""
+        if a is None or b is None:
+            return True
+        return a == b
+
     def _migrate(self, src: str, dst: str, body: dict) -> bool:
         """Best-effort move of the cached KV prefix for ``body``'s prompt
         from ``src`` to ``dst`` over the /kv control plane. Never raises;
         any failure just costs the re-prefill that would have happened
-        anyway. A 404 export (nothing cached) is a no-op, not a failure."""
+        anyway. A 404 export (nothing cached) is a no-op, not a failure.
+        Known-incompatible invariant geometry (mismatched fingerprints
+        off /health, or a 409 import) skips without charging
+        ``router.migration_failures`` — there is nothing to retry."""
         msgs = body.get("messages")
         if not isinstance(msgs, list) or not msgs:
+            return False
+        if not self._kv_compatible(self._state[src].kv_fingerprint,
+                                   self._state[dst].kv_fingerprint):
+            METRICS.incr("router.geometry_skips")
+            log.debug("kv migration %s->%s skipped: invariant "
+                      "fingerprints differ", src, dst)
             return False
         try:
             status, payload, _ = self.replicas[src].request(
@@ -389,6 +422,13 @@ class Router:
             status, imp, _ = self.replicas[dst].request(
                 "POST", "/kv/import", {"blob": blob}
             )
+            if status == 409:
+                # invariant geometry refusal: never retryable, distinct
+                # from a transient no-room failure
+                METRICS.incr("router.geometry_skips")
+                log.warning("kv migration %s->%s refused (409): "
+                            "invariant geometry mismatch", src, dst)
+                return False
             pages = int(imp.get("pages") or 0) if isinstance(imp, dict) else 0
             if status != 200 or pages <= 0:
                 # a refused import (no room) still means the session
@@ -420,10 +460,13 @@ class Router:
 
     # -- content-addressed prefixes (KV CDN) --------------------------------
 
-    def _push_prefix(self, src: str, dst: str, h: str) -> bool:
+    def _push_prefix(self, src: str, dst: str, h: str) -> int:
         """GET one content-addressed blob off ``src`` and push it into
-        ``dst``'s tier. True only when ``dst`` answered 200 (a dedup
-        ``stored: false`` still counts — the bytes are there). Never
+        ``dst``'s tier. Returns ``dst``'s HTTP status — 200 means landed
+        (a dedup ``stored: false`` still counts: the bytes are there),
+        409 means ``dst``'s invariant KV geometry can never accept
+        blobs from ``src`` (the caller should stop trying this pair),
+        0 means the source had nothing or transport failed. Never
         raises."""
         try:
             status, payload, _ = self.replicas[src].request(
@@ -431,15 +474,15 @@ class Router:
             )
             blob = payload.get("blob") if isinstance(payload, dict) else None
             if status != 200 or not blob:
-                return False
+                return 0
             status, _out, _ = self.replicas[dst].request(
                 "POST", "/kv/prefix", {"hash": h, "blob": blob}
             )
-            return status == 200
+            return int(status)
         except Exception as exc:  # noqa: BLE001 — a prefix push must
             # never take down the forward or sweep it rides along with
             log.debug("prefix push %s %s->%s failed: %r", h, src, dst, exc)
-            return False
+            return 0
 
     def _peer_prefix_sets(self, exclude=()) -> dict[str, set]:
         """Content hashes each reachable replica advertises. Draining
@@ -495,11 +538,20 @@ class Router:
             for h in want:  # longest prefix first (probe order)
                 srcs = [r for r, s in peers.items() if h in s]
                 for src in srcs:
-                    if self._push_prefix(src, rid, h):
+                    status = self._push_prefix(src, rid, h)
+                    if status == 200:
                         METRICS.incr("kv.prefix_hits_remote")
                         FLIGHT.event("router_prefix_fetch", src=src,
                                      dst=rid, hash=h)
                         return  # one prefix is all an admission can use
+                    if status == 409:
+                        # the destination's invariant KV geometry can
+                        # never admit this prompt's blobs — every
+                        # remaining hash shares the invariant, so the
+                        # whole fetch is futile (422-corrupt still
+                        # falls through to the next source)
+                        METRICS.incr("router.geometry_skips")
+                        return
                 if srcs:
                     METRICS.incr("router.prefix_fetch_failures")
         except Exception as exc:  # noqa: BLE001
@@ -541,10 +593,16 @@ class Router:
                         break
                     if h in have:
                         continue
-                    if self._push_prefix(src, rid, h):
+                    status = self._push_prefix(src, rid, h)
+                    if status == 200:
                         pushed += 1
                         have.add(h)
                         METRICS.incr("router.prewarm_pushes")
+                    elif status == 409:
+                        # every blob this source serves shares its
+                        # invariant geometry — move to the next source
+                        METRICS.incr("router.geometry_skips")
+                        break
                     else:
                         METRICS.incr("router.prewarm_failures")
         except Exception as exc:  # noqa: BLE001 — pre-warm is a bonus,
@@ -653,6 +711,8 @@ class Router:
                 "running": st.running,
                 "slots": st.slots,
                 "role": st.role,
+                "kv_fingerprint": st.kv_fingerprint,
+                "kv_layout": st.kv_layout,
             }
         return {"replicas": reps, "affinity_entries": len(self._affinity)}
 
@@ -973,12 +1033,27 @@ class Router:
         resumed stream's absolute re-export, or None when the session
         cannot move: a tool-grammar turn (never journaled), no ``fei``
         extension observed (non-engine provider), an expired deadline,
-        or no survivor that will take it."""
+        or no survivor that will take it.
+
+        The survivor does NOT have to share the dead replica's mesh:
+        teacher-forced replay moves the session as host-side token ids,
+        and tp/dp serving is token-identical to single-chip, so any
+        replica whose INVARIANT KV fingerprint matches can take it — a
+        tp2 death resurrects on a single-chip survivor byte-for-byte.
+        Known-incompatible invariants (a different model/page_size in a
+        heterogeneous fleet) are skipped without burning a stream
+        attempt."""
         if st["tools"] or not st["resumable"] or not st["toks"]:
             return None
         if remaining is not None and remaining <= 0:
             METRICS.incr("router.deadline_expired")
             return None
+        dead_fp = next(
+            (self._state[r].kv_fingerprint for r in dead
+             if r in self._state
+             and self._state[r].kv_fingerprint is not None),
+            None,
+        )
         body2 = {k: v for k, v in body.items() if k != "resume"}
         body2["resume"] = {"generated": [int(t) for t in st["toks"]],
                            "resume_key": st["key"]}
@@ -993,6 +1068,13 @@ class Router:
             if rid is None:
                 return None
             tried.add(rid)
+            if not self._kv_compatible(dead_fp,
+                                       self._state[rid].kv_fingerprint):
+                METRICS.incr("router.geometry_skips")
+                log.debug("resurrection skips %s: invariant kv "
+                          "fingerprint differs from the dead replica",
+                          rid)
+                continue
             try:
                 FAULTS.check("router.forward", replica=rid)
                 buffered, gen, err = self._try_stream(rid, body2, fwd)

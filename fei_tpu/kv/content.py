@@ -11,10 +11,13 @@ for the same tokens and therefore rendezvouses on the same bytes
 
 The chain mirrors ``PrefixCache._boundary_keys`` (the vLLM scheme):
 key_i = sha256(key_{i-1} || page_i token bytes), except the chain is
-SEEDED with a salt over the model id and pool fingerprint — two models
-with a shared tokenizer must never exchange KV bytes, and the page-count
-is excluded exactly as ``kv/migrate.py`` already does (pools of
-different sizes hold interchangeable pages).
+SEEDED with a salt over the model id and the pool's INVARIANT
+fingerprint — two models with a shared tokenizer must never exchange KV
+bytes. Page-count is excluded exactly as ``kv/migrate.py`` already does
+(pools of different sizes hold interchangeable pages), and so is the tp
+shard layout: a tp2 replica and a single chip compute the same content
+keys, which is what lets one mesh's published prefixes pre-warm another
+(the import path reshards; docs/KV.md "Mesh elasticity").
 
 Keys are strings with a ``cas:`` prefix so they coexist with session-rid
 spill keys in the same ``KVTierStore`` and are recognizable in

@@ -15,8 +15,14 @@ Contract:
 
 - The blob is the ``tier.pack_entry`` wire format with the prompt ids in
   the header's ``extra`` — self-describing, versioned, checksummed.
-- Import REFUSES a geometry mismatch (``pool_fingerprint``): replicas
-  serving different models/dtypes/page sizes simply don't exchange KV.
+- Import REFUSES only an INVARIANT geometry mismatch
+  (``pool_fingerprint`` — model shape, dtype, page size), with the
+  structured ``KVGeometryError`` the server maps to HTTP 409. A tp
+  *layout* skew resheds on scatter instead (``canonicalize_arrays``):
+  the host interchange format carries the full kv-head extent, so a
+  tp2-exported prefix lands in a tp4 (or single-chip) pool bitwise —
+  that's what makes heterogeneous fleets routable (docs/KV.md "Mesh
+  elasticity").
 - Import is best-effort and never preempts: it takes only pages the
   target pool can spare right now (after a prefix-cache eviction pass);
   a refused import costs one re-prefill, exactly the pre-migration
@@ -30,7 +36,14 @@ pool is single-owner state and migration must not race a dispatch.
 
 from __future__ import annotations
 
-from fei_tpu.kv.pagesio import gather_pages, pool_fingerprint, scatter_pages
+from fei_tpu.kv.pagesio import (
+    canonicalize_arrays,
+    check_fingerprint,
+    gather_pages,
+    pool_fingerprint,
+    scatter_pages,
+    shard_layout,
+)
 from fei_tpu.kv.tier import PageEntry, pack_entry, unpack_entry
 from fei_tpu.utils.errors import KVTierError
 from fei_tpu.utils.logging import get_logger
@@ -67,6 +80,9 @@ def export_blob(scheduler, prompt_ids: list[int]) -> bytes | None:
         page_size=ps,
         fingerprint=pool_fingerprint(pool),
         arrays=arrays,
+        layout=shard_layout(
+            pool.k_pages.shape[2], scheduler.engine.mesh
+        ),
     )
     blob = pack_entry(entry, extra={"prompt_ids": list(prompt_ids[:covered])})
     METRICS.incr("kv.migrations_out")
@@ -90,11 +106,15 @@ def import_blob(scheduler, blob: bytes) -> int:
     if prefix is None:
         raise KVTierError("target replica runs without a prefix cache")
     want = pool_fingerprint(pool)
-    if entry.fingerprint != want:
-        raise KVTierError(
-            f"migration blob geometry {entry.fingerprint} does not match "
-            f"this pool {want}"
-        )
+    # invariant mismatch (model/dtype/page size) -> KVGeometryError
+    # (HTTP 409, never retryable); a tp layout skew resheds below
+    check_fingerprint(want, entry.fingerprint, what="migration blob")
+    arrays = canonicalize_arrays(
+        entry.arrays, entry.layout, want["kv_heads"]
+    )
+    here = shard_layout(want["kv_heads"], scheduler.engine.mesh)
+    if entry.layout is not None and entry.layout.get("tp") != here["tp"]:
+        METRICS.incr("kv.resharded_imports")
     alloc = scheduler.engine._allocator
     n = entry.n_pages
     got = alloc.try_alloc(_IMPORT_ID, n)
@@ -104,7 +124,7 @@ def import_blob(scheduler, blob: bytes) -> int:
     if got is None:
         log.info("migration import refused: %d pages don't fit", n)
         return 0
-    scheduler._pool = scatter_pages(pool, got, entry.arrays)
+    scheduler._pool = scatter_pages(pool, got, arrays)
     prefix.register(prompt_ids, got)
     # the registry's refs keep the pages; drop the import's own claim
     alloc.free(_IMPORT_ID)

@@ -16,6 +16,26 @@ matter more than speed:
   a scatter lands each shard's slice on its device — the jitted
   programs below never mention the mesh and work for ms1 and tp2 both.
 
+That second invariant is what makes every durable KV artifact
+MESH-PORTABLE: the host interchange format is always the full kv-head
+extent in natural head order (the "canonical" layout), regardless of
+the tp degree that produced it, and a scatter re-slices it onto the
+destination pool's own sharding. The pool geometry therefore splits in
+two:
+
+- ``pool_fingerprint`` — the INVARIANT half (layers, total kv heads,
+  page_size, head_dim, dtype, quantized). Two pools exchange KV iff
+  this matches; content-addressed (CDN) keys salt with ONLY this half,
+  so tp2 and tp4 replicas rendezvous on the same ``cas:`` entries.
+- ``shard_layout`` — the LAYOUT half (tp degree + per-shard head
+  slices). Pure provenance, recorded in blob headers so operators and
+  the fleet can see a heterogeneous topology; ``canonicalize_arrays``
+  resheds (re-orders the head axis of) any blob whose recorded layout
+  is not already canonical, and refuses (``KVGeometryError``) one whose
+  slices don't cover the full head extent — the only layout that can
+  never scatter anywhere.
+
+
 Scatter donates the pool (the scheduler owns exactly one live pool
 value, same discipline as every dispatch); page-id lists are padded to
 a small multiple with the reserved null page 0 — inactive slots write
@@ -40,9 +60,12 @@ _ARRAY_FIELDS = ("k_pages", "v_pages", "k_scales", "v_scales")
 
 
 def pool_fingerprint(pool: PagedKVCache) -> dict:
-    """The per-page geometry a spilled entry must match to scatter back:
-    everything except the pool's total page count (two replicas with
-    different HBM budgets still exchange sessions)."""
+    """The INVARIANT per-page geometry a spilled entry must match to
+    scatter back: everything except the pool's total page count (two
+    replicas with different HBM budgets still exchange sessions) and its
+    tp shard layout (global shapes — ``k_pages.shape[2]`` is the TOTAL
+    kv-head extent however many shards hold it, so tp1/tp2/tp4 pools
+    over the same model agree on every key here)."""
     L, _, K, ps, D = pool.k_pages.shape
     return {
         "layers": int(L),
@@ -51,6 +74,100 @@ def pool_fingerprint(pool: PagedKVCache) -> dict:
         "head_dim": int(D),
         "dtype": str(pool.k_pages.dtype),
         "quantized": bool(pool.quantized),
+    }
+
+
+def config_fingerprint(cfg, page_size: int, dtype,
+                       kv_quant: str | None = None) -> dict:
+    """``pool_fingerprint`` derived from the model config alone — what a
+    replica advertises on ``/health`` before its pool exists (the pool is
+    built lazily on the scheduler loop; a health probe must not race
+    it). Guaranteed to equal ``pool_fingerprint`` of the pool
+    ``PagedKVCache.create`` would build from the same knobs."""
+    quantized = kv_quant == "int8"
+    pool_dtype = np.dtype(jnp.int8 if quantized else dtype)
+    return {
+        "layers": int(cfg.num_layers),
+        "kv_heads": int(cfg.num_kv_heads),
+        "page_size": int(page_size),
+        "head_dim": int(cfg.head_dim_),
+        "dtype": str(pool_dtype),
+        "quantized": quantized,
+    }
+
+
+def shard_layout(kv_heads: int, mesh=None) -> dict:
+    """The LAYOUT half of the pool geometry: how the kv-head extent is
+    currently sliced over tp. Provenance, not a compatibility gate —
+    blobs always travel in the canonical (full-extent, natural-order)
+    host layout, so any two layouts over the same invariant fingerprint
+    reshard freely at scatter."""
+    from fei_tpu.parallel.mesh import axis_size
+
+    tp = axis_size(mesh, "tp")
+    hps = int(kv_heads) // max(tp, 1)
+    return {
+        "tp": int(tp),
+        "head_slices": [[i * hps, (i + 1) * hps] for i in range(tp)],
+    }
+
+
+def check_fingerprint(ours: dict, theirs: dict, what: str = "blob") -> None:
+    """Refuse an INVARIANT geometry mismatch with the structured
+    ``{ours, theirs}`` diff (``KVGeometryError`` -> HTTP 409: never
+    retryable, unlike a corrupt blob's 422)."""
+    from fei_tpu.utils.errors import KVGeometryError
+
+    if dict(theirs) == dict(ours):
+        return
+    diff = sorted(
+        k for k in set(ours) | set(theirs) if ours.get(k) != theirs.get(k)
+    )
+    raise KVGeometryError(
+        f"{what} geometry is invariant-incompatible with this pool "
+        f"(differs on {', '.join(diff) or 'unknown keys'}): "
+        f"theirs={theirs} ours={ours}",
+        ours=ours, theirs=theirs,
+    )
+
+
+def canonicalize_arrays(
+    arrays: dict[str, np.ndarray], layout: dict | None, kv_heads: int
+) -> dict[str, np.ndarray]:
+    """Reshard host page arrays into the canonical layout (full kv-head
+    extent, natural head order) so they scatter into a pool of ANY tp
+    degree. A blob with no recorded layout (pre-reshard FKV1 writers) or
+    whose slices already concatenate in natural order is canonical
+    as-is; a permuted slice order re-orders the head axis (axis 2 of
+    both ``[n, L, K, ps, D]`` pages and ``[n, L, K, 1, ps]`` scale
+    pools); partial or overlapping head coverage raises
+    ``KVGeometryError`` — those bytes cannot serve the full extent on
+    any mesh."""
+    from fei_tpu.utils.errors import KVGeometryError
+
+    if not layout:
+        return arrays  # legacy blob: canonical by definition
+    slices = [
+        (int(lo), int(hi)) for lo, hi in (layout.get("head_slices") or [])
+    ]
+    if not slices:
+        # a tp degree with no slice list means the contiguous equal
+        # split shard_layout() describes — already natural order
+        return arrays
+    heads = [h for lo, hi in slices for h in range(lo, hi)]
+    if sorted(heads) != list(range(int(kv_heads))):
+        raise KVGeometryError(
+            f"blob layout {layout} does not cover kv heads "
+            f"[0, {kv_heads}) exactly once; these pages cannot serve "
+            "the full head extent on any mesh",
+            theirs={"layout": layout}, ours={"kv_heads": int(kv_heads)},
+        )
+    if heads == sorted(heads):
+        return arrays  # contiguous ascending slices: already canonical
+    idx = np.argsort(np.asarray(heads, dtype=np.int64), kind="stable")
+    return {
+        name: np.ascontiguousarray(np.take(a, idx, axis=2))
+        for name, a in arrays.items() if a is not None
     }
 
 

@@ -132,13 +132,19 @@ class PageEntry:
     """One spilled sequence's pages, page-axis-first host arrays (the
     ``pagesio.gather_pages`` layout) plus the geometry needed to refuse
     a mismatched scatter. ``n_tokens`` is the device ``lengths`` value
-    the entry restores (== len(_prefill_ids) for a settled slot)."""
+    the entry restores (== len(_prefill_ids) for a settled slot).
+    ``fingerprint`` is the INVARIANT geometry half (mesh-independent);
+    ``layout`` is the tp shard layout that produced the arrays —
+    provenance only (None on blobs written before mesh elasticity, read
+    as canonical): consumers reshard via ``pagesio.canonicalize_arrays``
+    instead of refusing a layout skew."""
 
     key: str
     n_tokens: int
     page_size: int
     fingerprint: dict
     arrays: dict[str, np.ndarray] = field(default_factory=dict)
+    layout: dict | None = None
 
     @property
     def n_pages(self) -> int:
@@ -169,6 +175,7 @@ def pack_entry(entry: PageEntry, extra: dict | None = None) -> bytes:
         "n_tokens": int(entry.n_tokens),
         "page_size": int(entry.page_size),
         "fingerprint": entry.fingerprint,
+        **({"layout": entry.layout} if entry.layout else {}),
         "manifest": [
             {
                 "name": n,
@@ -224,6 +231,8 @@ def unpack_entry(blob: bytes) -> tuple[PageEntry, dict]:
         page_size=int(header.get("page_size", 0)),
         fingerprint=dict(header.get("fingerprint") or {}),
         arrays=arrays,
+        # absent on pre-reshard writers: reads as the canonical layout
+        layout=dict(header["layout"]) if header.get("layout") else None,
     )
     return entry, dict(header.get("extra") or {})
 

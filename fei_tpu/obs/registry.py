@@ -292,6 +292,32 @@ METRIC_REGISTRY: dict[str, tuple[str, str]] = {
     "engine.crash_recoveries": ("counter",
                                 "Warm restarts that found and replayed "
                                 "at least one journaled session."),
+    "engine.recovery_skipped.*": ("counter",
+                                  "Journaled sessions a warm restart "
+                                  "could NOT re-admit, by reason "
+                                  "(page_size: the one geometry gate; "
+                                  "deadline_expired: the client's "
+                                  "budget ran out mid-crash)."),
+    "engine.cross_mesh_recoveries": ("counter",
+                                     "Journaled sessions re-admitted "
+                                     "onto a DIFFERENT mesh than the "
+                                     "one that crashed (tp2 journal "
+                                     "replayed on single-chip, etc.) — "
+                                     "byte-identical via teacher-"
+                                     "forced replay."),
+    "kv.resharded_imports": ("counter",
+                             "KV blobs (migration, CAS admit, CDN) "
+                             "imported across a tp layout skew — the "
+                             "host interchange format carries the full "
+                             "kv-head extent, so the scatter resheds "
+                             "instead of refusing."),
+    "router.geometry_skips": ("counter",
+                              "Fleet KV/session moves skipped because "
+                              "the replicas' INVARIANT fingerprints "
+                              "can never match (heterogeneous fleet: "
+                              "different model/dtype/page_size) — "
+                              "pre-flight off /health or a 409 from "
+                              "the /kv plane; never retried."),
     "router.resurrections": ("counter",
                              "Mid-stream sessions moved to a survivor "
                              "after their replica died with tokens "

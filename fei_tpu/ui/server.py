@@ -259,7 +259,7 @@ class ServeAPI:
             mesh = self._mesh_tag()
             load = self._load_fields()
             base = {"model": self.model_name, "mesh": mesh,
-                    "role": self.role, **load}
+                    "role": self.role, **self._kv_geometry(), **load}
             if self._draining():
                 # a draining replica must leave the load-balancer rotation
                 # while its in-flight set finishes
@@ -426,6 +426,24 @@ class ServeAPI:
         eng = getattr(self.provider, "engine", None)
         return mesh_tag(getattr(eng, "mesh", None))
 
+    def _kv_geometry(self) -> dict:
+        """Both halves of the KV pool geometry on /health — the
+        INVARIANT fingerprint (which replicas can exchange KV/sessions
+        at all) and the tp shard layout (pure provenance) — so fleet
+        placement can see a heterogeneous topology (a 70B tp4 rack next
+        to 8B tp2 replicas) without a scrape. Empty for non-engine and
+        dense providers; the router treats absence as compatible."""
+        eng = getattr(self.provider, "engine", None)
+        if eng is None or not hasattr(eng, "kv_fingerprint"):
+            return {}
+        try:
+            fp = eng.kv_fingerprint()
+            if fp is None:
+                return {}
+            return {"kv_fingerprint": fp, "kv_layout": eng.kv_layout()}
+        except Exception:  # noqa: BLE001 — /health must never 500
+            return {}
+
     def _degraded(self) -> bool:
         """True when the backing engine's crash-loop breaker is holding
         the scheduler degraded (non-engine providers: never)."""
@@ -532,10 +550,16 @@ class ServeAPI:
                      "blob": base64.b64encode(blob).decode("ascii")}
 
     def _kv_import(self, body: dict) -> tuple:
-        """Scatter a migration blob into this replica's pool. 422 for a
-        corrupt/mismatched blob (KVTierError); ``pages: 0`` when the pool
+        """Scatter a migration blob into this replica's pool. Two-rung
+        error ladder so the router can tell "never retry" from "bad
+        bytes, refetch elsewhere": 409 with a structured
+        ``{ours, theirs}`` geometry diff for an invariant-incompatible
+        blob (KVGeometryError — no replica of this pool shape will EVER
+        accept it; a tp layout skew resheds on scatter and never 409s),
+        422 for a corrupt/truncated blob (KVTierError — these bytes are
+        bad, but another copy may be fine). ``pages: 0`` when the pool
         can't spare room — best-effort by contract, never preempts."""
-        from fei_tpu.utils.errors import KVTierError
+        from fei_tpu.utils.errors import KVGeometryError, KVTierError
 
         sched = self._kv_scheduler()
         if sched is None:
@@ -553,6 +577,10 @@ class ServeAPI:
                                    "type": "invalid_request_error"}}
         try:
             pages = sched.import_prefix(blob)
+        except KVGeometryError as exc:
+            return 409, {"error": {"message": str(exc),
+                                   "type": "invalid_request_error",
+                                   "ours": exc.ours, "theirs": exc.theirs}}
         except KVTierError as exc:
             return 422, {"error": {"message": str(exc),
                                    "type": "invalid_request_error"}}
@@ -606,12 +634,17 @@ class ServeAPI:
         """Peer push: land a content-addressed blob in this replica's
         tier WITHOUT touching the pool — thread-safe, no loop-thread
         hop, no pages consumed; the next admission over matching tokens
-        fetches the pages in through ``_try_cas_admit``. 422 for a
-        corrupt blob or a non-content-addressed key; ``stored: false``
-        means the tier already held it (dedup), which is success."""
+        fetches the pages in through ``_try_cas_admit``. The same
+        409/422 ladder as /kv/import: 409 when the blob's INVARIANT
+        fingerprint can never match this replica's pool (storing it
+        would waste tier space on bytes no admission can use — a tp
+        layout skew is fine, admission resheds); 422 for a corrupt blob
+        or a non-content-addressed key. ``stored: false`` means the
+        tier already held it (dedup), which is success."""
         from fei_tpu.kv.content import is_cas_key
+        from fei_tpu.kv.pagesio import check_fingerprint
         from fei_tpu.kv.tier import unpack_entry
-        from fei_tpu.utils.errors import KVTierError
+        from fei_tpu.utils.errors import KVGeometryError, KVTierError
 
         tier = self._kv_tier_store()
         if tier is None:
@@ -639,6 +672,18 @@ class ServeAPI:
                 "message": "hash does not name a content-addressed "
                            "prefix blob",
                 "type": "invalid_request_error"}}
+        # a well-formed blob whose INVARIANT geometry can never match
+        # this pool is refused up front (409): storing it would spend
+        # tier budget on bytes no admission here can ever use
+        want = self._kv_geometry().get("kv_fingerprint")
+        if want is not None:
+            try:
+                check_fingerprint(want, entry.fingerprint,
+                                  what="pushed prefix blob")
+            except KVGeometryError as exc:
+                return 409, {"error": {
+                    "message": str(exc), "type": "invalid_request_error",
+                    "ours": exc.ours, "theirs": exc.theirs}}
         try:
             stored = tier.put_if_absent(key, entry)
         except Exception as exc:  # noqa: BLE001 — injected spill faults

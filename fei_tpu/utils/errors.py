@@ -111,6 +111,38 @@ class KVTierError(EngineError):
     spill just forfeits the fast-resume path — neither may wedge a slot."""
 
 
+class KVGeometryError(KVTierError):
+    """The blob's INVARIANT pool geometry (layers, total kv heads,
+    page_size, head_dim, dtype, quantized) can never scatter into this
+    pool — a different model, dtype, or page size, not a different mesh.
+    Never retryable: the server maps this to HTTP 409 with the
+    ``{ours, theirs}`` diff so the router stops re-offering the blob,
+    unlike a corrupt/truncated 422 it may refetch elsewhere. A mere tp
+    *layout* skew is NOT this error — layout resheds on scatter."""
+
+    def __init__(self, message: str, *, ours: dict | None = None,
+                 theirs: dict | None = None,
+                 cause: Exception | None = None):
+        super().__init__(message, cause=cause)
+        self.ours = dict(ours or {})
+        self.theirs = dict(theirs or {})
+
+
+class PageSizeMismatchError(CheckpointError):
+    """A durable artifact (drain snapshot, journal session) was produced
+    under a different KV page_size than this engine serves. Page size
+    changes the paged kernel's summation order, so a cross-page_size
+    replay cannot promise byte-identity — the ONE geometry axis warm
+    restart still refuses (mesh shape resheds/replays freely)."""
+
+    def __init__(self, message: str, *, ours: int | None = None,
+                 theirs: int | None = None,
+                 cause: Exception | None = None):
+        super().__init__(message, cause=cause)
+        self.ours = ours
+        self.theirs = theirs
+
+
 class ToolError(FeiError):
     """Tool registration, validation, or execution failure."""
 

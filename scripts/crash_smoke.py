@@ -23,6 +23,23 @@ sockets:
    byte-identical (the PRNG key chain survived the crash);
 4. B's journal dir, rebooted, recovers the torn seeded session too.
 
+``FEI_TPU_CRASH_SMOKE_MODE=reshard`` (the ``chaos_reshard`` pipeline
+stage) runs the MESH-SHRINK scene instead — the common TPU failure
+where a chip or ICI link dies and the replica re-forms smaller:
+
+1. a ``FEI_TPU_MESH=tp2`` serve (two forced host devices) and a
+   single-chip survivor boot side by side; their /health pages must
+   agree on the INVARIANT kv fingerprint while the layouts differ;
+2. this script kill -9s the tp2 process mid-greedy-stream; the router
+   teacher-forces the delivered suffix onto the SINGLE-CHIP survivor
+   and the client text must be byte-identical to the single-chip
+   reference (cross-mesh resurrection, zero accepted-token loss);
+3. a single-chip process reboots on the dead tp2 replica's journal AND
+   KV-tier directories; it must re-admit the torn session
+   (``journal.recovered_sessions``) and count it as a cross-mesh
+   recovery (``engine.cross_mesh_recoveries``) — mesh is provenance,
+   page_size is the only gate (docs/ENGINE.md "Mesh elasticity").
+
 Runs on CPU by design: several serve processes cannot share one
 accelerator, and everything under test (WAL, resurrection ledger,
 teacher-forced resume) is host-side. Exit 0 clean, non-zero with a
@@ -62,12 +79,15 @@ def _free_port() -> int:
 
 
 def _spawn(name: str, port: int, jdir: str, log_path: str,
-           fault: str = "") -> subprocess.Popen:
+           fault: str = "",
+           extra_env: dict | None = None) -> subprocess.Popen:
     env = dict(os.environ)
     # scrub knobs meant for OTHER smokes; the pipeline chaos sweep must
-    # not leak a fault into a replica that is supposed to stay up
+    # not leak a fault (or a mesh/tier shape) into a replica that is
+    # supposed to boot plain
     for k in list(env):
-        if k.startswith("FEI_TPU_JOURNAL") or k == "FEI_TPU_FAULT":
+        if (k.startswith("FEI_TPU_JOURNAL") or k.startswith("FEI_TPU_KV_")
+                or k in ("FEI_TPU_FAULT", "FEI_TPU_MESH", "XLA_FLAGS")):
             env.pop(k)
     env.update({
         "JAX_PLATFORMS": "cpu",
@@ -76,6 +96,7 @@ def _spawn(name: str, port: int, jdir: str, log_path: str,
         "FEI_TPU_JOURNAL_DIR": jdir,
         "FEI_TPU_JOURNAL_SYNC": "always",
     })
+    env.update(extra_env or {})
     if fault:
         env["FEI_TPU_FAULT"] = fault
     logf = open(log_path, "ab")
@@ -324,5 +345,162 @@ def main() -> int:
                 pass
 
 
+def main_reshard() -> int:
+    """The mesh-shrink scene: kill -9 a tp2 serve mid-stream, recover
+    everything on single-chip machinery (module docstring, mode 2)."""
+    import json
+
+    from fei_tpu.fleet import HttpReplica, Router
+    from fei_tpu.utils.metrics import METRICS
+
+    work = tempfile.mkdtemp(prefix="fei-reshard-smoke-")
+    jdir_t, jdir_s = os.path.join(work, "jt"), os.path.join(work, "js")
+    kv_dir = os.path.join(work, "kv")
+    for d in (jdir_t, jdir_s, kv_dir):
+        os.makedirs(d)
+    procs: list[subprocess.Popen] = []
+
+    def spawn(name, jdir, extra=None):
+        port = _free_port()
+        log_path = os.path.join(work, f"{name}.log")
+        proc = _spawn(name, port, jdir, log_path, extra_env=extra)
+        procs.append(proc)
+        return port, proc, log_path
+
+    def counter(name: str) -> float:
+        return METRICS.snapshot()["counters"].get(name, 0)
+
+    def health(port: int) -> dict:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/health", timeout=10
+        ) as r:
+            return json.load(r)
+
+    # the dying replica serves SHARDED (two forced host devices); the
+    # shrunk reboot and the survivor are single-chip — unequal meshes
+    # on purpose. The tp2 replica's KV tier spills to a directory the
+    # shrunk reboot re-opens, so durable KV crosses the shrink too.
+    tp2_env = {
+        "FEI_TPU_MESH": "tp2",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+        "FEI_TPU_KV_TIER": "disk",
+        "FEI_TPU_KV_DISK_DIR": kv_dir,
+    }
+    shrunk_env = {
+        "FEI_TPU_KV_TIER": "disk",
+        "FEI_TPU_KV_DISK_DIR": kv_dir,
+    }
+    try:
+        port_s, proc_s, log_s = spawn("survivor", jdir_s)
+        port_t, proc_t, log_t = spawn("tp2", jdir_t, extra=tp2_env)
+        for name, port, proc, logp in (
+            ("survivor", port_s, proc_s, log_s),
+            ("tp2", port_t, proc_t, log_t),
+        ):
+            err = _wait_health(name, port, proc, logp)
+            if err:
+                return fail(err)
+        h_t, h_s = health(port_t), health(port_s)
+        if h_t.get("mesh") != "tp2":
+            return fail(f"tp2 replica reports mesh {h_t.get('mesh')!r}")
+        if (h_t.get("kv_layout") or {}).get("tp") != 2:
+            return fail(f"tp2 replica advertises layout "
+                        f"{h_t.get('kv_layout')!r}")
+        if h_t.get("kv_fingerprint") != h_s.get("kv_fingerprint"):
+            return fail(
+                "invariant kv fingerprints differ across the mesh skew: "
+                f"tp2={h_t.get('kv_fingerprint')!r} "
+                f"ms1={h_s.get('kv_fingerprint')!r}"
+            )
+        print("crash smoke[reshard]: tp2 + single-chip healthy; invariant "
+              "fingerprints agree, layouts differ")
+
+        # --- reference: the single-chip bytes the shrink must preserve -
+        ref = HttpReplica("ref", f"http://127.0.0.1:{port_s}",
+                          timeout_s=600.0)
+        ref_greedy, errs, _, _ = _consume(ref.stream(_body("ref-g", False)))
+        if errs or not ref_greedy:
+            return fail(f"reference stream failed: {errs}")
+        print(f"crash smoke[reshard]: reference captured "
+              f"({len(ref_greedy)} chars)")
+
+        # --- kill -9 the tp2 replica mid-stream: the session must
+        # resurrect on the SINGLE-CHIP survivor byte-identically --------
+        c0 = counter("router.resurrections")
+        router = Router(
+            [HttpReplica("t", f"http://127.0.0.1:{port_t}",
+                         timeout_s=600.0),
+             HttpReplica("s", f"http://127.0.0.1:{port_s}",
+                         timeout_s=600.0)],
+            retries=2, backoff_s=0.05, health_ttl_s=0.5,
+        )
+        content, errors, ids, _ = _consume(
+            router.stream_chat(_body("shrink-greedy", False), {}),
+            kill_pid=proc_t.pid, kill_after=1,
+        )
+        if errors:
+            return fail(f"shrink stream surfaced error frames: {errors}")
+        if content != ref_greedy:
+            return fail(
+                "content diverged across the tp2 -> single-chip shrink "
+                "(token loss!)\n"
+                f"  ref: {ref_greedy!r}\n  got: {content!r}"
+            )
+        if len(ids) != 1:
+            return fail(f"stream identity changed across failover: {ids}")
+        if counter("router.resurrections") - c0 != 1:
+            return fail("router.resurrections did not move — the tp2 "
+                        "replica never died mid-stream? returncode=%s"
+                        % proc_t.poll())
+        proc_t.wait(timeout=30)
+        if proc_t.returncode != -signal.SIGKILL:
+            return fail(f"tp2 replica exited rc={proc_t.returncode}, "
+                        "expected the external SIGKILL")
+        print("crash smoke[reshard]: tp2 kill -9'd mid-stream; resurrected "
+              "on the single-chip survivor byte-identical")
+
+        # --- reboot SINGLE-CHIP on the dead tp2 journal + KV dirs ------
+        port_t2, proc_t2, log_t2 = spawn("shrunk", jdir_t,
+                                         extra=shrunk_env)
+        err = _wait_health("shrunk", port_t2, proc_t2, log_t2)
+        if err:
+            return fail(err)
+        if health(port_t2).get("mesh") == "tp2":
+            return fail("the shrunk reboot came back SHARDED — the scene "
+                        "must cross meshes")
+        for prom, what in (
+            ("fei_journal_recovered_sessions_total",
+             "journal recovery"),
+            ("fei_engine_cross_mesh_recoveries_total",
+             "cross-mesh accounting"),
+        ):
+            err = _wait_metric("shrunk", port_t2, prom, 1)
+            if err:
+                tail = Path(log_t2).read_bytes()[-2000:].decode(
+                    "utf-8", "replace")
+                return fail(f"{err} ({what}); log tail:\n{tail}")
+        print("crash smoke[reshard]: single-chip reboot on the tp2 "
+              "journal+KV dirs re-admitted the torn session "
+              "(cross-mesh recovery counted)")
+
+        replayed = counter("router.resurrection_replayed_tokens")
+        print(f"crash smoke[reshard]: OK — tp2 died, single-chip machinery "
+              f"recovered every byte ({replayed:.0f} tokens "
+              f"teacher-forced, 0 lost)")
+        return 0
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except Exception:  # noqa: BLE001
+                pass
+
+
 if __name__ == "__main__":
-    raise SystemExit(main())
+    _mode = os.environ.get(
+        "FEI_TPU_CRASH_SMOKE_MODE", "crash"
+    ).strip().lower()
+    raise SystemExit(main_reshard() if _mode == "reshard" else main())

@@ -193,7 +193,11 @@ stage journal_tests env FEI_TPU_TEST_PLATFORM=tpu python -m pytest \
 stage crash_recovery env FEI_TPU_TEST_PLATFORM=tpu python -m pytest \
   tests/test_crash_recovery.py -q --timeout 900
 stage chaos_crash env JAX_PLATFORMS=cpu python -u scripts/crash_smoke.py
+stage chaos_reshard env JAX_PLATFORMS=cpu \
+  FEI_TPU_CRASH_SMOKE_MODE=reshard python -u scripts/crash_smoke.py
 stage bench_crash run_bench env FEI_TPU_BENCH_SUITE=crash \
+  FEI_TPU_BENCH_MAX_WAIT_S=300 python -u bench.py
+stage bench_reshard run_bench env FEI_TPU_BENCH_SUITE=reshard \
   FEI_TPU_BENCH_MAX_WAIT_S=300 python -u bench.py
 
 # 0d1c. tiered KV store ON-CHIP (docs/KV.md): spill/restore

@@ -222,7 +222,14 @@ stage journal_tests -- python -m pytest tests/test_journal.py -q \
 stage crash_recovery -- python -m pytest tests/test_crash_recovery.py -q \
   --timeout 900
 stage chaos_crash -- env JAX_PLATFORMS=cpu python -u scripts/crash_smoke.py
+# the mesh-shrink scene: kill -9 a tp2 serve mid-stream, resurrect on a
+# single-chip survivor byte-identically, reboot single-chip on the tp2
+# journal+KV dirs (docs/ENGINE.md "Mesh elasticity")
+stage chaos_reshard -- env JAX_PLATFORMS=cpu \
+  FEI_TPU_CRASH_SMOKE_MODE=reshard python -u scripts/crash_smoke.py
 stage bench_crash --json -- env FEI_TPU_BENCH_SUITE=crash python -u bench.py
+stage bench_reshard --json -- env FEI_TPU_BENCH_SUITE=reshard \
+  python -u bench.py
 
 # --- tiered KV store (docs/KV.md): the kv suite runs FOR REAL (spill/
 # restore byte-identity, demotion, corrupt fallback, migration

@@ -7,7 +7,11 @@ The claims under test (docs/ENGINE.md "Crash consistency", docs/FLEET.md
   re-admitted by ``engine.warm_restart()`` and their streams replay
   BYTE-IDENTICAL to the uninterrupted reference, greedy and seeded
   (the journaled per-step PRNG keys re-enter the sampling chain
-  exactly), single-chip and tp2;
+  exactly), single-chip and tp2 — and the reboot does NOT need the
+  dead process's mesh: journaled sessions are host-side token state,
+  so a tp2 journal recovers on a single chip (mesh is provenance;
+  page_size is the one geometry axis recovery still refuses, with a
+  visible ``engine.recovery_skipped.page_size`` counter);
 - the fleet router resurrects a stream whose replica died AFTER tokens
   flowed: the delivered suffix teacher-forces onto a survivor via the
   per-frame ``fei`` extension ledger, the replayed prefix is
@@ -163,13 +167,22 @@ class TestJournalReplay:
                  "deadline_epoch": 1.0})  # expired decades ago
         assert j.flush()
         j.close()
+        c0 = _counter("engine.recovery_skipped.deadline_expired")
         eng = _journal_engine(jdir)
         try:
             assert eng.warm_restart() == []
+            # a dropped session must be visible, not silent
+            assert _counter(
+                "engine.recovery_skipped.deadline_expired"
+            ) - c0 == 1
         finally:
             eng.close()
 
-    def test_recovery_skips_mesh_mismatch(self, tmp_path):
+    def test_recovery_crosses_mesh(self, tmp_path):
+        """A journaled session from a DIFFERENT mesh re-admits: sessions
+        are host-side token state and tp serving is token-identical to
+        single-chip, so mesh is provenance — the common TPU shrink (a
+        chip dies, the replica re-forms smaller) loses nothing."""
         from fei_tpu.engine.journal import SessionJournal
 
         jdir = str(tmp_path / "wal")
@@ -179,11 +192,37 @@ class TestJournalReplay:
                  "mesh": {"tp": 8}})
         assert j.flush()
         j.close()
+        c0 = _counter("engine.cross_mesh_recoveries")
         eng = _journal_engine(jdir)
         try:
-            # byte-identical replay is only defined on the geometry the
-            # KV was produced on: drop, don't guess
+            restored = eng.warm_restart()
+            assert len(restored) == 1
+            assert _counter("engine.cross_mesh_recoveries") - c0 == 1
+            toks = list(eng.scheduler.drain(restored[0]))
+            assert len(toks) == 4
+        finally:
+            eng.close()
+
+    def test_recovery_skips_page_size_mismatch(self, tmp_path):
+        """page_size is the one geometry axis journal recovery refuses
+        (it changes the paged kernel's summation order): the session
+        drops with a visible counter instead of replaying wrong."""
+        from fei_tpu.engine.journal import SessionJournal
+
+        jdir = str(tmp_path / "wal")
+        j = SessionJournal(jdir)
+        j.admit({"rid": "coarse", "prompt_ids": PROMPT,
+                 "gen": {"max_new_tokens": 4, "ignore_eos": True},
+                 "page_size": 999})
+        assert j.flush()
+        j.close()
+        c0 = _counter("engine.recovery_skipped.page_size")
+        eng = _journal_engine(jdir)
+        try:
             assert eng.warm_restart() == []
+            assert _counter(
+                "engine.recovery_skipped.page_size"
+            ) - c0 == 1
         finally:
             eng.close()
 

@@ -12,8 +12,10 @@ The claims under test (docs/ENGINE.md "Mesh modes"):
   is per-replica, the scheduler serves dp× slots.
 - The PR 4-5 survival machinery keeps working sharded: preempt-and-resume
   stays byte-identical under tp2, drain → warm-restart round-trips, and a
-  warm restart onto a DIFFERENT mesh geometry is refused with a typed
-  error (the snapshot file survives the refusal).
+  warm restart onto a DIFFERENT mesh geometry RESTORES byte-identically
+  (mesh is provenance since snapshot v3; docs/ENGINE.md "Mesh
+  elasticity"). The one geometry axis still refused is page_size, with a
+  typed error — and the snapshot file survives the refusal.
 
 Everything runs on the conftest-forced 8-device CPU host mesh.
 """
@@ -282,30 +284,67 @@ class TestShardedSurvival:
         eng2.scheduler.close()
         assert outs == refs
 
-    def test_warm_restart_refuses_mesh_mismatch(self, monkeypatch, tmp_path):
+    def test_warm_restart_crosses_mesh_byte_identical(self, monkeypatch,
+                                                      tmp_path):
+        """The shrink scenario: a tp2 replica drains, the replacement
+        boots on a SINGLE chip, and the restored stream is byte-identical
+        to an uninterrupted single-chip run — snapshot mesh is
+        provenance (v3), not a restore gate."""
         gen = _gen()
+        ref_eng = _make(monkeypatch, None)
+        ref = list(ref_eng.scheduler.stream(PROMPT, gen))
+        ref_eng.scheduler.close()
+
         eng = _make(monkeypatch, "tp2")
         sched = eng.scheduler
         monkeypatch.setattr(sched, "_start_thread", lambda: None)
         sched.submit(PROMPT, gen)
         eng.begin_drain(deadline_s=0, snapshot_dir=str(tmp_path))
         assert sched.wait_drained(timeout=10)
+        snaps = load_request_snapshots(str(tmp_path))
+        assert all(s["mesh"]["tp"] == 2 for s in snaps)
 
         ms1 = _make(monkeypatch, None)
-        with pytest.raises(CheckpointError, match="mesh"):
-            ms1.warm_restart(str(tmp_path))
-        ms1.scheduler.close()
-
-        # the refusal must NOT consume the snapshots: a matching engine
-        # still restores them afterwards
-        eng2 = _make(monkeypatch, "tp2")
-        restored = eng2.warm_restart(str(tmp_path))
+        restored = ms1.warm_restart(str(tmp_path))
         assert len(restored) == 1
-        eng2.scheduler.close()
+        out = list(ms1.scheduler.drain(restored[0]))
+        ms1.scheduler.close()
+        assert out == ref
 
-    def test_legacy_v1_snapshots_read_as_single_chip(self, tmp_path):
-        """A v1 file (pre-mesh) must load on a single-chip engine and be
-        refused by a sharded one."""
+    def test_warm_restart_refuses_page_size_mismatch(self, monkeypatch,
+                                                     tmp_path):
+        """page_size is the ONE geometry axis restore still gates on
+        (it changes the paged kernel's summation order): typed error
+        naming both sizes, and the snapshot file survives the refusal
+        so a matching engine still restores afterwards."""
+        from fei_tpu.utils.errors import PageSizeMismatchError
+
+        gen = _gen()
+        eng = _make(monkeypatch, None, page_size=4)
+        sched = eng.scheduler
+        monkeypatch.setattr(sched, "_start_thread", lambda: None)
+        sched.submit(PROMPT, gen)
+        eng.begin_drain(deadline_s=0, snapshot_dir=str(tmp_path))
+        assert sched.wait_drained(timeout=10)
+
+        other = _make(monkeypatch, None, page_size=8)
+        monkeypatch.setattr(other.scheduler, "_start_thread",
+                            lambda: None)
+        with pytest.raises(PageSizeMismatchError) as exc:
+            other.warm_restart(str(tmp_path))
+        assert exc.value.ours == 8 and exc.value.theirs == 4
+        assert isinstance(exc.value, CheckpointError)  # old catches work
+        other.scheduler.close()
+
+        same = _make(monkeypatch, None, page_size=4)
+        monkeypatch.setattr(same.scheduler, "_start_thread", lambda: None)
+        assert len(same.warm_restart(str(tmp_path))) == 1
+        same.scheduler.close()
+
+    def test_legacy_v1_snapshots_load_on_any_mesh(self, tmp_path):
+        """A v1 file (pre-mesh, pre-page_size) loads everywhere: its
+        writer's only page size was the default, and mesh stopped being
+        a gate in v3."""
         import json
         import os
 
@@ -317,13 +356,15 @@ class TestShardedSurvival:
             str(tmp_path), expect_mesh=mesh_geometry(None)
         ) == snaps
         tp2_geo = dict(mesh_geometry(None), tp=2)
-        with pytest.raises(CheckpointError, match="mesh"):
-            load_request_snapshots(str(tmp_path), expect_mesh=tp2_geo)
+        assert load_request_snapshots(
+            str(tmp_path), expect_mesh=tp2_geo, expect_page_size=64
+        ) == snaps
 
     def test_save_records_geometry(self, tmp_path):
-        save_request_snapshots(str(tmp_path), [{"rid": "r"}])
+        save_request_snapshots(str(tmp_path), [{"rid": "r"}], page_size=16)
         import json
 
         payload = json.loads((tmp_path / "requests.json").read_text())
-        assert payload["version"] == 2
+        assert payload["version"] == 3
         assert payload["mesh"] == mesh_geometry(None)
+        assert payload["page_size"] == 16
